@@ -1,0 +1,24 @@
+"""Test harness configuration.
+
+Mirrors the reference's two-runner strategy (SURVEY.md §4): fast in-process
+tests on a SIMULATED multi-device mesh — 8 virtual CPU devices via
+``xla_force_host_platform_device_count`` — so distributed sharding/collective
+paths compile and run without TPU hardware (reference analog:
+``testing/trino-testing/.../DistributedQueryRunner.java`` spinning N servers
+in one JVM).
+
+Must run before jax initializes, hence environment mutation at import time.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

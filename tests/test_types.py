@@ -1,0 +1,53 @@
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+
+
+def test_parse_simple_types():
+    assert T.parse_type("bigint") is T.BIGINT
+    assert T.parse_type("BOOLEAN") is T.BOOLEAN
+    assert T.parse_type("double") is T.DOUBLE
+    assert T.parse_type("date") is T.DATE
+    assert T.parse_type("varchar") == T.VARCHAR
+
+
+def test_parse_parameterized():
+    v = T.parse_type("varchar(25)")
+    assert v.is_string and v.length == 25
+    d = T.parse_type("decimal(12, 2)")
+    assert d.is_decimal and d.precision == 12 and d.scale == 2
+    assert d.storage == np.dtype(np.int64)
+
+
+def test_decimal_raw_roundtrip():
+    d = T.decimal_type(12, 2)
+    assert d.to_raw("123.45") == 12345
+    assert d.from_raw(12345) == Decimal("123.45")
+    assert d.to_raw(7) == 700
+
+
+def test_decimal_over_18_rejected():
+    with pytest.raises(T.TypeError_):
+        T.decimal_type(19, 0)
+
+
+def test_common_super_type():
+    assert T.common_super_type(T.INTEGER, T.BIGINT) is T.BIGINT
+    assert T.common_super_type(T.BIGINT, T.DOUBLE) is T.DOUBLE
+    assert T.common_super_type(T.UNKNOWN, T.DATE) is T.DATE
+    d1 = T.decimal_type(10, 2)
+    d2 = T.decimal_type(5, 0)
+    c = T.common_super_type(d1, d2)
+    assert c.precision == 10 and c.scale == 2
+    assert T.common_super_type(d1, T.BIGINT).scale == 2
+    assert T.common_super_type(T.parse_type("varchar(3)"), T.VARCHAR) == T.VARCHAR
+    assert T.common_super_type(T.DATE, T.TIMESTAMP) is T.TIMESTAMP
+
+
+def test_storage_dtypes():
+    assert T.BIGINT.storage == np.dtype(np.int64)
+    assert T.DATE.storage == np.dtype(np.int32)
+    assert T.VARCHAR.storage == np.dtype(np.int32)  # dictionary codes

@@ -1,0 +1,27 @@
+"""trino_tpu — a TPU-native distributed SQL query engine.
+
+A brand-new MPP SQL engine with the capabilities of Trino (reference:
+verdantforce/trino, surveyed in SURVEY.md), designed TPU-first:
+
+- columnar Page/Block batches live on device as padded ``jax`` arrays
+  (reference analog: ``core/trino-spi/src/main/java/io/trino/spi/Page.java``)
+- operator hot paths (filter/project, group-by aggregation, hash join
+  build/probe, partitioned output) are jit-compiled XLA programs
+  (reference analog: runtime bytecode generation in
+  ``core/trino-main/.../sql/gen/``)
+- stage-boundary hash repartitioning is an XLA ``all_to_all`` over a
+  ``jax.sharding.Mesh`` (reference analog: the HTTP page shuffle in
+  ``core/trino-main/.../operator/DirectExchangeClient.java``)
+
+The control plane (parser, analyzer, planner, scheduler, protocol) is
+Python; the data plane is XLA.
+"""
+
+import jax
+
+# SQL semantics need exact 64-bit integers (BIGINT keys, DECIMAL-as-scaled-
+# int64 accumulators) and true DOUBLE. TPU emulates s64/f64; hot kernels
+# narrow to 32-bit lanes where the data allows.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,279 @@
+"""Columnar Page/Block data model.
+
+Reference analog: ``core/trino-spi/src/main/java/io/trino/spi/Page.java`` and
+the 69 block classes under ``spi/block/`` (ByteArrayBlock, LongArrayBlock,
+VariableWidthBlock, DictionaryBlock, RunLengthEncodedBlock, ...).
+
+TPU-first redesign: a Block is ONE flat array per column (the type's device
+storage dtype) plus an optional null mask — no per-width block subclasses;
+the dtype carries that. Strings are dictionary codes (int32) with the string
+pool held host-side (``Dictionary``), so every device kernel sees only
+fixed-width lanes. Arrays may live on host (numpy) or device (jax.Array);
+kernels pad to power-of-two bucket sizes so XLA compiles a small, reusable
+set of shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Union
+
+import numpy as np
+
+from . import types as T
+
+Array = Union[np.ndarray, "jax.Array"]  # noqa: F821
+
+
+def padded_size(n: int, minimum: int = 16) -> int:
+    """Pad row counts to power-of-two buckets => bounded jit cache size."""
+    if n <= minimum:
+        return minimum
+    return 1 << (n - 1).bit_length()
+
+
+class Dictionary:
+    """Host-side string pool. Identity (``id()``) defines code compatibility:
+    two blocks share code semantics iff they share the Dictionary object.
+
+    Reference analog: ``spi/block/DictionaryBlock.java`` +
+    ``VariableWidthBlock.java`` — but here the pool is a first-class engine
+    object because device kernels only ever see codes.
+    """
+
+    __slots__ = ("values", "_index", "_sort_rank")
+
+    def __init__(self, values: Sequence[str] = ()):
+        self.values: list = list(values)
+        self._index = {v: i for i, v in enumerate(self.values)}
+        self._sort_rank = None
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def code(self, value: str) -> int:
+        """Code for value, adding it to the pool if absent."""
+        c = self._index.get(value)
+        if c is None:
+            c = len(self.values)
+            self.values.append(value)
+            self._index[value] = c
+            self._sort_rank = None
+        return c
+
+    def lookup(self, value: str) -> int:
+        """Code for value or -1 if absent (no mutation)."""
+        return self._index.get(value, -1)
+
+    def encode(self, strings: Sequence[Optional[str]]) -> np.ndarray:
+        """Encode strings to codes. NULL lanes get code 0 — they carry an
+        arbitrary valid code and MUST be masked by the block's null mask
+        (kernels fold the null bit into key comparisons explicitly)."""
+        out = np.empty(len(strings), dtype=np.int32)
+        for i, s in enumerate(strings):
+            if s is None:
+                if not self.values:
+                    self.code("")  # keep code 0 decodable on an empty pool
+                out[i] = 0
+            else:
+                out[i] = self.code(s)
+        return out
+
+    def decode(self, codes: np.ndarray) -> list:
+        vals = self.values
+        return [vals[c] for c in codes]
+
+    def sort_rank(self) -> np.ndarray:
+        """rank[code] = position of values[code] in lexicographic order.
+        Lets ORDER BY on strings run on device: order by rank[codes]."""
+        if self._sort_rank is None or len(self._sort_rank) != len(self.values):
+            order = np.argsort(np.asarray(self.values, dtype=object), kind="stable")
+            rank = np.empty(len(self.values), dtype=np.int32)
+            rank[order] = np.arange(len(self.values), dtype=np.int32)
+            self._sort_rank = rank
+        return self._sort_rank
+
+
+@dataclass
+class Block:
+    """One column of a Page: flat storage array + optional null mask."""
+
+    type: T.Type
+    data: Array                      # shape (n,), dtype == type.storage
+    nulls: Optional[Array] = None    # bool, True => NULL; None => no nulls
+    dictionary: Optional[Dictionary] = None
+
+    def __post_init__(self):
+        if self.type.is_string and self.dictionary is None:
+            raise ValueError("string block requires a dictionary")
+
+    def __len__(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def may_have_nulls(self) -> bool:
+        return self.nulls is not None
+
+    # -- host/device movement ------------------------------------------------
+
+    def numpy(self) -> "Block":
+        if isinstance(self.data, np.ndarray) and (
+            self.nulls is None or isinstance(self.nulls, np.ndarray)
+        ):
+            return self
+        nulls = None if self.nulls is None else np.asarray(self.nulls)
+        return Block(self.type, np.asarray(self.data), nulls, self.dictionary)
+
+    def nulls_array(self) -> np.ndarray:
+        if self.nulls is None:
+            return np.zeros(len(self), dtype=bool)
+        return np.asarray(self.nulls)
+
+    # -- positional ops (reference: Block.getRegion / copyPositions) ---------
+
+    def region(self, offset: int, length: int) -> "Block":
+        nulls = None if self.nulls is None else self.nulls[offset:offset + length]
+        return Block(self.type, self.data[offset:offset + length], nulls,
+                     self.dictionary)
+
+    def take(self, positions) -> "Block":
+        nulls = None if self.nulls is None else self.nulls[positions]
+        return Block(self.type, self.data[positions], nulls, self.dictionary)
+
+    def filter(self, keep_mask) -> "Block":
+        mask = np.asarray(keep_mask)
+        return self.numpy().take(np.nonzero(mask)[0])
+
+    # -- python-value conversion --------------------------------------------
+
+    def to_pylist(self) -> list:
+        b = self.numpy()
+        data, t = b.data, b.type
+        nulls = b.nulls_array() if b.nulls is not None else None
+        if t.is_string:
+            raw = b.dictionary.decode(data)
+        elif t.is_decimal:
+            raw = [t.from_raw(v) for v in data.tolist()]
+        elif t == T.BOOLEAN:
+            raw = [bool(v) for v in data]
+        elif t in (T.DOUBLE, T.REAL):
+            raw = [float(v) for v in data]
+        else:
+            raw = [int(v) for v in data.tolist()]
+        if nulls is None:
+            return raw
+        return [None if n else v for v, n in zip(raw, nulls)]
+
+    @staticmethod
+    def from_pylist(type_: T.Type, values: Sequence,
+                    dictionary: Optional[Dictionary] = None) -> "Block":
+        n = len(values)
+        nulls = np.fromiter((v is None for v in values), dtype=bool, count=n)
+        has_nulls = bool(nulls.any())
+        if type_.is_string:
+            d = dictionary if dictionary is not None else Dictionary()
+            data = d.encode(values)
+            return Block(type_, data, nulls if has_nulls else None, d)
+        data = np.empty(n, dtype=type_.storage)
+        for i, v in enumerate(values):
+            if v is None:
+                data[i] = 0
+            elif type_.is_decimal:
+                data[i] = type_.to_raw(v)
+            else:
+                data[i] = v
+        return Block(type_, data, nulls if has_nulls else None)
+
+
+@dataclass
+class Page:
+    """A batch of rows: one Block per channel (reference: ``spi/Page.java:32``)."""
+
+    blocks: list
+    num_rows: int
+
+    def __post_init__(self):
+        for b in self.blocks:
+            assert len(b) == self.num_rows, \
+                f"block length {len(b)} != page rows {self.num_rows}"
+
+    @property
+    def channel_count(self) -> int:
+        return len(self.blocks)
+
+    def block(self, channel: int) -> Block:
+        return self.blocks[channel]
+
+    def region(self, offset: int, length: int) -> "Page":
+        return Page([b.region(offset, length) for b in self.blocks], length)
+
+    def take(self, positions) -> "Page":
+        positions = np.asarray(positions)
+        return Page([b.take(positions) for b in self.blocks], len(positions))
+
+    def filter(self, keep_mask) -> "Page":
+        positions = np.nonzero(np.asarray(keep_mask))[0]
+        return self.take(positions)
+
+    def select_channels(self, channels: Sequence[int]) -> "Page":
+        return Page([self.blocks[c] for c in channels], self.num_rows)
+
+    def to_pydict(self, names: Sequence[str]) -> dict:
+        return {n: b.to_pylist() for n, b in zip(names, self.blocks)}
+
+    def to_rows(self) -> list:
+        cols = [b.to_pylist() for b in self.blocks]
+        return [tuple(c[i] for c in cols) for i in range(self.num_rows)]
+
+    @staticmethod
+    def from_pylists(types_: Sequence[T.Type], columns: Sequence[Sequence],
+                     dictionaries: Optional[Sequence] = None) -> "Page":
+        assert len(types_) == len(columns)
+        n = len(columns[0]) if columns else 0
+        blocks = []
+        for i, (t, col) in enumerate(zip(types_, columns)):
+            d = dictionaries[i] if dictionaries else None
+            blocks.append(Block.from_pylist(t, col, d))
+        return Page(blocks, n)
+
+    @staticmethod
+    def concat(pages: Sequence["Page"]) -> "Page":
+        if not pages:
+            raise ValueError(
+                "Page.concat of zero pages: caller must use empty_page(types)")
+        pages = [p for p in pages if p.num_rows > 0] or list(pages[:1])
+        if len(pages) == 1:
+            return pages[0]
+        nch = pages[0].channel_count
+        blocks = []
+        for c in range(nch):
+            parts = [p.block(c).numpy() for p in pages]
+            t = parts[0].type
+            dictionary = parts[0].dictionary
+            if t.is_string:
+                # Re-encode into the first block's dictionary when pools differ.
+                unified = []
+                for b in parts:
+                    if b.dictionary is dictionary:
+                        unified.append(b.data)
+                    else:
+                        remap = dictionary.encode(b.dictionary.values) if len(b.dictionary) else np.empty(0, np.int32)
+                        unified.append(remap[b.data] if len(remap) else b.data)
+                data = np.concatenate(unified)
+            else:
+                data = np.concatenate([b.data for b in parts])
+            if any(b.nulls is not None for b in parts):
+                nulls = np.concatenate([b.nulls_array() for b in parts])
+            else:
+                nulls = None
+            blocks.append(Block(t, data, nulls, dictionary))
+        return Page(blocks, sum(p.num_rows for p in pages))
+
+
+def empty_page(types_: Sequence[T.Type],
+               dictionaries: Optional[Sequence] = None) -> Page:
+    blocks = []
+    for i, t in enumerate(types_):
+        d = (dictionaries[i] if dictionaries else None) or (Dictionary() if t.is_string else None)
+        blocks.append(Block(t, np.empty(0, dtype=t.storage), None, d))
+    return Page(blocks, 0)
