@@ -13,12 +13,25 @@ Must run before jax initializes, hence environment mutation at import time.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# assignment, not setdefault: the axon sitecustomize pre-sets
+# JAX_PLATFORMS=axon (the real-TPU tunnel); tests run on the virtual mesh
+_platform = os.environ.get("TRINO_TPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+# the axon sitecustomize imports jax at interpreter startup, so env vars
+# alone are too late — force platform + persistent compile cache (repeat
+# test runs skip XLA compilation) through the live config
+import jax  # noqa: E402
+
+if _platform:
+    jax.config.update("jax_platforms", _platform)
+jax.config.update("jax_compilation_cache_dir", "/tmp/trino_tpu_jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

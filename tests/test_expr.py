@@ -1,0 +1,177 @@
+from decimal import Decimal
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.block import DevicePage, Page
+from trino_tpu.expr import Call, InputRef, Literal, PageProcessor
+from trino_tpu.expr.functions import days_from_civil_host
+
+
+def run(input_types, columns, projections, filter_expr=None):
+    page = Page.from_pylists(input_types, columns)
+    proc = PageProcessor(input_types, projections, filter_expr)
+    out = proc.process(DevicePage.from_page(page))
+    return out.to_page()
+
+
+def c(ch, t):
+    return InputRef(t, ch)
+
+
+def lit(v, t):
+    return Literal(t, v)
+
+
+def call(name, t, *args):
+    return Call(t, name, tuple(args))
+
+
+def test_arithmetic_bigint():
+    out = run([T.BIGINT, T.BIGINT], [[1, 2, None], [10, 20, 30]],
+              [call("add", T.BIGINT, c(0, T.BIGINT), c(1, T.BIGINT)),
+               call("multiply", T.BIGINT, c(0, T.BIGINT), lit(3, T.BIGINT))])
+    assert out.to_rows() == [(11, 3), (22, 6), (None, None)]
+
+
+def test_decimal_arithmetic_matches_reference_rules():
+    d12_2 = T.decimal_type(12, 2)
+    # l_extendedprice * (1 - l_discount) — the q1/q6 revenue expression
+    one = lit(1, T.BIGINT)
+    disc = c(1, d12_2)
+    price = c(0, d12_2)
+    sub = call("subtract", T.decimal_type(13, 2), one, disc)
+    mul = call("multiply", T.decimal_type(18, 4), price, sub)
+    out = run([d12_2, d12_2], [["100.00", "10.00"], ["0.05", "0.10"]], [mul])
+    assert out.block(0).to_pylist() == [Decimal("95.0000"), Decimal("9.0000")]
+
+
+def test_decimal_divide_rounding():
+    d4_2 = T.decimal_type(4, 2)
+    expr = call("divide", T.decimal_type(8, 2), c(0, d4_2), c(1, d4_2))
+    out = run([d4_2, d4_2], [["1.00", "-1.00"], ["3.00", "3.00"]], [expr])
+    # 1/3 = 0.33 (round half up), -1/3 = -0.33 (away from zero)
+    assert out.block(0).to_pylist() == [Decimal("0.33"), Decimal("-0.33")]
+
+
+def test_filter_and_three_valued_logic():
+    b = T.BOOLEAN
+    x = c(0, T.BIGINT)
+    f = call("$and", b,
+             call("gt", b, x, lit(1, T.BIGINT)),
+             call("lt", b, x, lit(5, T.BIGINT)))
+    out = run([T.BIGINT], [[0, 2, None, 4, 7]], [x], f)
+    assert out.block(0).to_pylist() == [2, 4]
+
+
+def test_or_null_semantics():
+    b = T.BOOLEAN
+    x = c(0, T.BIGINT)
+    # (x > 10) OR (x < 100) is TRUE even when one side is NULL? No —
+    # NULL input makes both sides NULL; OR of (NULL, NULL) is NULL => drop.
+    f = call("$or", b,
+             call("gt", b, x, lit(10, T.BIGINT)),
+             call("lt", b, x, lit(3, T.BIGINT)))
+    out = run([T.BIGINT], [[1, 5, None, 50]], [x], f)
+    assert out.block(0).to_pylist() == [1, 50]
+
+
+def test_case_expression():
+    x = c(0, T.BIGINT)
+    expr = call("$case", T.BIGINT,
+                call("lt", T.BOOLEAN, x, lit(0, T.BIGINT)), lit(-1, T.BIGINT),
+                call("eq", T.BOOLEAN, x, lit(0, T.BIGINT)), lit(0, T.BIGINT),
+                lit(1, T.BIGINT))
+    out = run([T.BIGINT], [[-5, 0, 9, None]], [expr])
+    assert out.block(0).to_pylist() == [-1, 0, 1, 1]  # NULL: no cond fires -> default
+
+
+def test_coalesce_and_is_null():
+    x = c(0, T.BIGINT)
+    out = run([T.BIGINT], [[1, None]],
+              [call("$coalesce", T.BIGINT, x, lit(42, T.BIGINT)),
+               call("$is_null", T.BOOLEAN, x)])
+    assert out.to_rows() == [(1, False), (42, True)]
+
+
+def test_string_comparison_and_like():
+    v = T.VARCHAR
+    s = c(0, v)
+    out = run([v], [["AIR", "MAIL", "SHIP", "AIR REG", None]],
+              [call("eq", T.BOOLEAN, s, lit("AIR", v)),
+               call("$like", T.BOOLEAN, s, lit("%AI%", v)),
+               call("lt", T.BOOLEAN, s, lit("MAIL", v))])
+    rows = out.to_rows()
+    assert rows[0] == (True, True, True)     # AIR
+    assert rows[1] == (False, True, False)   # MAIL
+    assert rows[2] == (False, False, False)  # SHIP
+    assert rows[3] == (False, True, True)    # AIR REG
+    assert rows[4] == (None, None, None)
+
+
+def test_string_functions_via_dictionary():
+    v = T.VARCHAR
+    s = c(0, v)
+    sub = call("substr", v, s, lit(1, T.BIGINT), lit(2, T.BIGINT))
+    out = run([v], [["PROMO BURNISHED", "STANDARD", None]],
+              [call("length", T.BIGINT, s),
+               sub,
+               call("eq", T.BOOLEAN, sub, lit("PR", v))])
+    assert out.to_rows() == [(15, "PR", True), (8, "ST", False),
+                             (None, None, None)]
+
+
+def test_in_lists():
+    v = T.VARCHAR
+    out = run([v, T.BIGINT], [["a", "b", "c"], [1, 2, 3]],
+              [call("$in", T.BOOLEAN, c(0, v), lit("a", v), lit("c", v)),
+               call("$in", T.BOOLEAN, c(1, T.BIGINT),
+                    lit(1, T.BIGINT), lit(3, T.BIGINT))])
+    assert out.to_rows() == [(True, True), (False, False), (True, True)]
+
+
+def test_date_extract_and_interval():
+    d = days_from_civil_host
+    dates = [d(1994, 1, 1), d(1995, 12, 31), d(1996, 2, 29)]
+    x = c(0, T.DATE)
+    out = run([T.DATE], [dates],
+              [call("$extract_year", T.BIGINT, x),
+               call("$extract_month", T.BIGINT, x),
+               call("$extract_day", T.BIGINT, x),
+               call("add", T.DATE, x,
+                    lit(3, T.INTERVAL_YEAR_MONTH))])  # + 3 months
+    rows = out.to_rows()
+    assert [r[0] for r in rows] == [1994, 1995, 1996]
+    assert [r[1] for r in rows] == [1, 12, 2]
+    assert [r[2] for r in rows] == [1, 31, 29]
+    assert rows[0][3] == d(1994, 4, 1)
+    assert rows[1][3] == d(1996, 3, 31)
+    assert rows[2][3] == d(1996, 5, 29)
+
+
+def test_between_dates():
+    d = days_from_civil_host
+    x = c(0, T.DATE)
+    f = call("$between", T.BOOLEAN, x,
+             lit(d(1994, 1, 1), T.DATE), lit(d(1994, 12, 31), T.DATE))
+    out = run([T.DATE], [[d(1993, 6, 1), d(1994, 6, 1), d(1995, 6, 1)]],
+              [x], f)
+    assert out.block(0).to_pylist() == [d(1994, 6, 1)]
+
+
+def test_cast_decimal_double():
+    d12_2 = T.decimal_type(12, 2)
+    x = c(0, d12_2)
+    out = run([d12_2], [["12.50"]],
+              [Call(T.DOUBLE, "$cast", (x,)),
+               Call(T.BIGINT, "$cast", (x,))])
+    assert out.to_rows() == [(12.5, 12)]
+
+
+def test_cast_varchar_to_date():
+    v = T.VARCHAR
+    out = run([v], [["1998-09-02", None]],
+              [Call(T.DATE, "$cast", (c(0, v),))])
+    assert out.block(0).to_pylist() == [
+        days_from_civil_host(1998, 9, 2), None]

@@ -1,0 +1,98 @@
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import DevicePage, Page
+from trino_tpu.ops.join import (HashBuilderOperator, JoinBridge,
+                                LookupJoinOperator)
+from trino_tpu.ops.sort import OrderByOperator, TopNOperator
+from trino_tpu.ops.sortkeys import SortKey
+
+
+def dev(types_, cols):
+    return DevicePage.from_page(Page.from_pylists(types_, cols))
+
+
+def build_probe(build_types, build_cols, build_keys, probe_types,
+                probe_cols, probe_keys, join_type="inner"):
+    bridge = JoinBridge()
+    builder = HashBuilderOperator(build_types, build_keys, bridge)
+    builder.add_input(dev(build_types, build_cols))
+    builder.finish()
+    builder.get_output()
+    probe = LookupJoinOperator(probe_types, probe_keys, bridge, join_type)
+    probe.add_input(dev(probe_types, probe_cols))
+    out = probe.get_output()
+    return out.to_page() if out is not None else None
+
+
+def test_inner_join_single_key():
+    out = build_probe(
+        [T.BIGINT, T.VARCHAR], [[1, 2, 2, 4], ["a", "b", "c", "d"]], [0],
+        [T.BIGINT, T.BIGINT], [[2, 1, 5, 2], [10, 20, 30, 40]], [0])
+    rows = sorted(out.to_rows())
+    # probe rows with key 2 match two build rows each; key 5 drops
+    assert rows == sorted([
+        (2, 10, 2, "b"), (2, 10, 2, "c"), (1, 20, 1, "a"),
+        (2, 40, 2, "b"), (2, 40, 2, "c")])
+
+
+def test_left_join_emits_unmatched_with_nulls():
+    out = build_probe(
+        [T.BIGINT, T.VARCHAR], [[1], ["a"]], [0],
+        [T.BIGINT], [[1, 3]], [0], join_type="left")
+    rows = sorted(out.to_rows(), key=lambda r: r[0])
+    assert rows == [(1, 1, "a"), (3, None, None)]
+
+
+def test_join_null_keys_never_match():
+    out = build_probe(
+        [T.BIGINT], [[1, None]], [0],
+        [T.BIGINT], [[1, None]], [0])
+    assert out.to_rows() == [(1, 1)]
+
+
+def test_semi_and_anti_join():
+    semi = build_probe([T.BIGINT], [[2, 4]], [0],
+                       [T.BIGINT], [[1, 2, 3, 4]], [0], join_type="semi")
+    assert sorted(r[0] for r in semi.to_rows()) == [2, 4]
+    anti = build_probe([T.BIGINT], [[2, 4]], [0],
+                       [T.BIGINT], [[1, 2, 3, 4]], [0], join_type="anti")
+    assert sorted(r[0] for r in anti.to_rows()) == [1, 3]
+
+
+def test_two_key_join():
+    out = build_probe(
+        [T.BIGINT, T.BIGINT, T.VARCHAR],
+        [[1, 1, 2], [10, 20, 10], ["x", "y", "z"]], [0, 1],
+        [T.BIGINT, T.BIGINT], [[1, 2, 1], [20, 10, 99]], [0, 1])
+    rows = sorted(out.to_rows())
+    assert rows == sorted([(1, 20, 1, 20, "y"), (2, 10, 2, 10, "z")])
+
+
+def test_order_by_multi_key_with_nulls():
+    op = OrderByOperator([T.BIGINT, T.DOUBLE],
+                         [SortKey(0, ascending=True),
+                          SortKey(1, ascending=False)])
+    op.add_input(dev([T.BIGINT, T.DOUBLE],
+                     [[3, 1, None, 1], [1.5, 2.5, 9.9, 0.5]]))
+    op.finish()
+    out = op.get_output().to_page()
+    # asc nulls last on key0; desc on key1
+    assert out.to_rows() == [(1, 2.5), (1, 0.5), (3, 1.5), (None, 9.9)]
+
+
+def test_order_by_strings_uses_rank():
+    op = OrderByOperator([T.VARCHAR], [SortKey(0)])
+    op.add_input(dev([T.VARCHAR], [[ "pear", "apple", "mango"]]))
+    op.finish()
+    out = op.get_output().to_page()
+    assert out.block(0).to_pylist() == ["apple", "mango", "pear"]
+
+
+def test_topn_streaming():
+    op = TopNOperator([T.BIGINT], [SortKey(0, ascending=False)], 3)
+    op.add_input(dev([T.BIGINT], [[5, 1, 9]]))
+    op.add_input(dev([T.BIGINT], [[7, 2, 8, 3]]))
+    op.finish()
+    out = op.get_output().to_page()
+    assert out.block(0).to_pylist() == [9, 8, 7]

@@ -1,0 +1,129 @@
+import pytest
+
+from trino_tpu.resources.tpch_queries import TPCH_QUERIES
+from trino_tpu.sql import ast
+from trino_tpu.sql.parser import ParseError, parse_expression, parse_statement
+
+
+@pytest.mark.parametrize("qid", sorted(TPCH_QUERIES))
+def test_parses_all_tpch_queries(qid):
+    stmt = parse_statement(TPCH_QUERIES[qid])
+    assert isinstance(stmt, ast.QueryStatement)
+
+
+def test_simple_select_shape():
+    s = parse_statement("select a, b + 1 as c from t where a > 5 "
+                        "group by a, b order by c desc limit 10")
+    q = s.query
+    spec = q.body
+    assert isinstance(spec, ast.QuerySpecification)
+    assert len(spec.select_items) == 2
+    assert spec.select_items[1].alias == "c"
+    assert isinstance(spec.where, ast.ComparisonExpression)
+    assert len(spec.group_by.expressions) == 2
+    assert q.order_by[0].ascending is False
+    assert q.limit == 10
+
+
+def test_expression_precedence():
+    e = parse_expression("1 + 2 * 3")
+    assert isinstance(e, ast.ArithmeticBinary) and e.op == "+"
+    assert isinstance(e.right, ast.ArithmeticBinary) and e.right.op == "*"
+
+    e = parse_expression("a or b and c")
+    assert isinstance(e, ast.LogicalBinary) and e.op == "OR"
+    assert isinstance(e.right, ast.LogicalBinary) and e.right.op == "AND"
+
+    e = parse_expression("not a = b")
+    assert isinstance(e, ast.NotExpression)
+    assert isinstance(e.value, ast.ComparisonExpression)
+
+
+def test_predicates():
+    e = parse_expression("x between 1 and 10")
+    assert isinstance(e, ast.BetweenPredicate)
+    e = parse_expression("x not in (1, 2)")
+    assert isinstance(e, ast.NotExpression)
+    assert isinstance(e.value, ast.InPredicate)
+    e = parse_expression("name like 'a%' escape '\\'")
+    assert isinstance(e, ast.LikePredicate)
+    e = parse_expression("x is not null")
+    assert isinstance(e, ast.IsNotNullPredicate)
+
+
+def test_date_interval_literals():
+    e = parse_expression("date '1998-12-01' - interval '90' day")
+    assert isinstance(e, ast.ArithmeticBinary)
+    assert isinstance(e.left, ast.GenericLiteral)
+    assert isinstance(e.right, ast.IntervalLiteral)
+    assert e.right.unit == "day"
+
+
+def test_case_forms():
+    e = parse_expression(
+        "case when a > 0 then 'pos' when a < 0 then 'neg' else 'zero' end")
+    assert isinstance(e, ast.SearchedCase)
+    assert len(e.when_clauses) == 2
+    e = parse_expression("case x when 1 then 'one' else 'other' end")
+    assert isinstance(e, ast.SimpleCase)
+
+
+def test_subqueries():
+    s = parse_statement(
+        "select * from t where exists (select 1 from u where u.a = t.a)")
+    w = s.query.body.where
+    assert isinstance(w, ast.ExistsPredicate)
+    e = parse_expression("x = (select max(y) from t)")
+    assert isinstance(e.right, ast.ScalarSubquery)
+    e = parse_expression("x > all (select y from t)")
+    assert isinstance(e, ast.QuantifiedComparison)
+
+
+def test_joins():
+    s = parse_statement(
+        "select * from a left outer join b on a.x = b.x "
+        "join c using (y) cross join d")
+    rel = s.query.body.from_
+    assert isinstance(rel, ast.Join) and rel.join_type == "CROSS"
+    assert rel.left.join_type == "INNER"
+    assert rel.left.using_columns == ("y",)
+    assert rel.left.left.join_type == "LEFT"
+
+
+def test_with_and_setops():
+    s = parse_statement(
+        "with r as (select a from t) "
+        "select * from r union all select * from r "
+        "intersect select * from r")
+    q = s.query
+    assert len(q.with_queries) == 1
+    assert isinstance(q.body, ast.SetOperation)
+    assert q.body.op == "UNION" and not q.body.distinct
+
+
+def test_window_function():
+    e = parse_expression(
+        "rank() over (partition by a order by b desc "
+        "rows between unbounded preceding and current row)")
+    assert isinstance(e, ast.FunctionCall)
+    assert e.window is not None
+    assert e.window.frame[0] == "rows"
+
+
+def test_statements():
+    assert isinstance(parse_statement("show tables"), ast.ShowTables)
+    assert isinstance(parse_statement("show catalogs"), ast.ShowCatalogs)
+    assert isinstance(parse_statement("explain select 1"), ast.Explain)
+    s = parse_statement("create table x as select 1 as a")
+    assert isinstance(s, ast.CreateTableAsSelect)
+    s = parse_statement("insert into t (a, b) select 1, 2")
+    assert isinstance(s, ast.Insert) and s.columns == ("a", "b")
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse_statement("select from where")
+    with pytest.raises(ParseError):
+        parse_statement("select 1 extra_garbage ,")
+    with pytest.raises(ParseError):
+        parse_expression("1 +")

@@ -1,0 +1,152 @@
+"""Milestone A gate: TPC-H q1 as a hand-built physical pipeline on tpch.tiny
+(reference analog: testing/trino-benchmark HandTpchQuery1), cross-checked
+against an independent numpy computation of the same generated data."""
+
+from decimal import Decimal
+
+import numpy as np
+
+from trino_tpu import types as T
+from trino_tpu.block import Page
+from trino_tpu.connectors.tpch import TpchConnector, _SCHEMAS
+from trino_tpu.exec.driver import Driver
+from trino_tpu.expr import Call, InputRef, Literal, PageProcessor
+from trino_tpu.expr.functions import days_from_civil_host
+from trino_tpu.ops.aggregation import AggCall, HashAggregationOperator, resolve_agg_type
+from trino_tpu.ops.operator import (FilterProjectOperator,
+                                    OutputCollectorOperator,
+                                    TableScanOperator)
+
+D = T.decimal_type(12, 2)
+
+
+def build_q1_driver(conn, schema="micro"):
+    meta = conn.metadata()
+    table = meta.get_table_handle(schema, "lineitem")
+    cols = {c.name: c for c in meta.get_columns(table)}
+    scan_cols = [cols[n] for n in
+                 ["l_returnflag", "l_linestatus", "l_quantity",
+                  "l_extendedprice", "l_discount", "l_tax", "l_shipdate"]]
+    scan = TableScanOperator(conn, scan_cols)
+
+    # channels in scan order
+    rf, ls, qty, price, disc, tax, ship = [
+        InputRef(c.type, i) for i, c in enumerate(scan_cols)]
+    cutoff = days_from_civil_host(1998, 12, 1) - 90
+    filt = Call(T.BOOLEAN, "le", (ship, Literal(T.DATE, cutoff)))
+    one = Literal(T.BIGINT, 1)
+    disc_price_t = T.decimal_type(18, 4)
+    disc_price = Call(disc_price_t, "multiply",
+                      (price, Call(T.decimal_type(13, 2), "subtract", (one, disc))))
+    charge_t = T.decimal_type(18, 6)
+    charge = Call(charge_t, "multiply",
+                  (disc_price, Call(T.decimal_type(13, 2), "add", (one, tax))))
+    proc = PageProcessor([c.type for c in scan_cols],
+                         [rf, ls, qty, price, disc, tax, disc_price, charge],
+                         filt)
+    fp = FilterProjectOperator(proc)
+
+    aggs = []
+    for fn, ch, t in [("sum", 2, D), ("sum", 3, D), ("sum", 6, disc_price_t),
+                      ("sum", 7, charge_t), ("avg", 2, D), ("avg", 3, D),
+                      ("avg", 4, D), ("count_star", None, None)]:
+        aggs.append(AggCall(fn, ch, t, resolve_agg_type(fn, t)))
+    agg = HashAggregationOperator(proc.output_types, [0, 1], aggs)
+
+    sink = OutputCollectorOperator()
+    driver = Driver([scan, fp, agg, sink])
+    splits = conn.split_manager().get_splits(table, 4)
+    for s in splits:
+        driver.add_split(s)
+    driver.no_more_splits()
+    return driver, sink
+
+
+def reference_q1(conn, schema="micro"):
+    """Independent numpy computation over the same generated pages."""
+    meta = conn.metadata()
+    table = meta.get_table_handle(schema, "lineitem")
+    cols = {c.name: c for c in meta.get_columns(table)}
+    names = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+             "l_discount", "l_tax", "l_shipdate"]
+    splits = conn.split_manager().get_splits(table, 4)
+    pages = []
+    for s in splits:
+        src = conn.page_source(s, [cols[n] for n in names])
+        while True:
+            p = src.get_next_page()
+            if p is None:
+                break
+            pages.append(p)
+    page = Page.concat(pages)
+    rf = np.asarray(page.block(0).data)
+    ls = np.asarray(page.block(1).data)
+    d_rf, d_ls = page.block(0).dictionary, page.block(1).dictionary
+    qty = np.asarray(page.block(2).data).astype(object)
+    price = np.asarray(page.block(3).data).astype(object)
+    disc = np.asarray(page.block(4).data).astype(object)
+    tax = np.asarray(page.block(5).data).astype(object)
+    ship = np.asarray(page.block(6).data)
+    cutoff = days_from_civil_host(1998, 12, 1) - 90
+    keep = ship <= cutoff
+    out = {}
+    for i in np.nonzero(keep)[0]:
+        key = (d_rf.values[rf[i]], d_ls.values[ls[i]])
+        g = out.setdefault(key, [0, 0, 0, 0, 0])
+        g[0] += qty[i]
+        g[1] += price[i]
+        disc_price = price[i] * (100 - disc[i])          # scale 4
+        g[2] += disc_price
+        g[3] += disc_price * (100 + tax[i])              # scale 6
+        g[4] += 1
+    return out
+
+
+def test_q1_tiny_end_to_end():
+    conn = TpchConnector(page_rows=8192)
+    driver, sink = build_q1_driver(conn)
+    driver.run_to_completion()
+    result = Page.concat(sink.pages)
+    expected = reference_q1(conn)
+
+    assert result.num_rows == len(expected) == 4  # 4 (flag,status) groups
+    names_rows = result.to_rows()
+    for row in names_rows:
+        key = (row[0], row[1])
+        exp = expected[key]
+        sum_qty, sum_price, sum_disc_price, sum_charge = row[2], row[3], row[4], row[5]
+        avg_qty, avg_price, avg_disc, cnt = row[6], row[7], row[8], row[9]
+        assert sum_qty == Decimal(exp[0]).scaleb(-2), key
+        assert sum_price == Decimal(exp[1]).scaleb(-2), key
+        assert sum_disc_price == Decimal(exp[2]).scaleb(-4), key
+        assert sum_charge == Decimal(exp[3]).scaleb(-6), key
+        assert cnt == exp[4]
+        # avg: exact decimal division round-half-up
+        assert avg_qty == (Decimal(exp[0]).scaleb(-2) / exp[4]).quantize(
+            Decimal("0.01"), rounding="ROUND_HALF_UP"), key
+        assert avg_price == (Decimal(exp[1]).scaleb(-2) / exp[4]).quantize(
+            Decimal("0.01"), rounding="ROUND_HALF_UP"), key
+
+
+def test_tpch_generator_determinism():
+    conn = TpchConnector()
+    t = conn.table("orders")
+    a = t.generate(0.01, 100, 200, ["o_orderkey", "o_totalprice",
+                                    "o_orderstatus"])
+    b = t.generate(0.01, 150, 160, ["o_orderkey", "o_totalprice",
+                                    "o_orderstatus"])
+    # same rows regardless of the requested range
+    assert a.region(50, 10).to_rows() == b.to_rows()
+
+
+def test_tpch_partsupp_lineitem_join_keys():
+    """Every (l_partkey, l_suppkey) pair must exist in partsupp."""
+    conn = TpchConnector()
+    li = conn.table("lineitem").generate(0.01, 0, 500,
+                                         ["l_partkey", "l_suppkey"])
+    ps = conn.table("partsupp").generate(
+        0.01, 0, conn.table("partsupp").row_count(0.01),
+        ["ps_partkey", "ps_suppkey"])
+    pairs = set(zip(ps.block(0).to_pylist(), ps.block(1).to_pylist()))
+    for pk, sk in zip(li.block(0).to_pylist(), li.block(1).to_pylist()):
+        assert (pk, sk) in pairs

@@ -1,0 +1,133 @@
+"""Hand-built benchmark pipelines (reference analog:
+``testing/trino-benchmark/src/main/java/io/trino/benchmark/HandTpchQuery1``)
+plus the pure jittable "one device step" used by the compile-check entry.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import types as T
+from .block import DevicePage, Page
+from .connectors.tpch import TpchConnector
+from .exec.driver import Driver
+from .expr import Call, InputRef, Literal, PageProcessor
+from .expr.functions import days_from_civil_host
+from .ops.aggregation import (AggCall, HashAggregationOperator,
+                              _group_reduce, _init_states, _state_plan,
+                              resolve_agg_type)
+from .ops.operator import (FilterProjectOperator, OutputCollectorOperator,
+                           TableScanOperator, ValuesOperator)
+from .ops.sortkeys import group_operands
+
+D12_2 = T.decimal_type(12, 2)
+
+Q1_COLUMNS = ["l_returnflag", "l_linestatus", "l_quantity", "l_extendedprice",
+              "l_discount", "l_tax", "l_shipdate"]
+
+
+def q1_expressions(input_types: List[T.Type]):
+    rf, ls, qty, price, disc, tax, ship = [
+        InputRef(t, i) for i, t in enumerate(input_types)]
+    cutoff = days_from_civil_host(1998, 12, 1) - 90
+    filt = Call(T.BOOLEAN, "le", (ship, Literal(T.DATE, cutoff)))
+    one = Literal(T.BIGINT, 1)
+    disc_price_t = T.decimal_type(18, 4)
+    disc_price = Call(disc_price_t, "multiply",
+                      (price, Call(T.decimal_type(13, 2), "subtract",
+                                   (one, disc))))
+    charge_t = T.decimal_type(18, 6)
+    charge = Call(charge_t, "multiply",
+                  (disc_price, Call(T.decimal_type(13, 2), "add",
+                                    (one, tax))))
+    projections = [rf, ls, qty, price, disc, tax, disc_price, charge]
+    aggs = []
+    for fn, ch, t in [("sum", 2, D12_2), ("sum", 3, D12_2),
+                      ("sum", 6, disc_price_t), ("sum", 7, charge_t),
+                      ("avg", 2, D12_2), ("avg", 3, D12_2), ("avg", 4, D12_2),
+                      ("count_star", None, None)]:
+        aggs.append(AggCall(fn, ch, t, resolve_agg_type(fn, t)))
+    return projections, filt, aggs
+
+
+def build_q1_driver(conn: TpchConnector, schema: str = "tiny",
+                    source_pages: Optional[Sequence[Page]] = None,
+                    desired_splits: int = 4):
+    """q1 as a physical pipeline. With source_pages, scanning is replaced by
+    a ValuesOperator so the measurement isolates device execution."""
+    meta = conn.metadata()
+    table = meta.get_table_handle(schema, "lineitem")
+    cols = {c.name: c for c in meta.get_columns(table)}
+    scan_cols = [cols[n] for n in Q1_COLUMNS]
+    input_types = [c.type for c in scan_cols]
+    projections, filt, aggs = q1_expressions(input_types)
+    proc = PageProcessor(input_types, projections, filt)
+    fp = FilterProjectOperator(proc)
+    agg = HashAggregationOperator(proc.output_types, [0, 1], aggs)
+    sink = OutputCollectorOperator()
+    if source_pages is not None:
+        driver = Driver([ValuesOperator(source_pages), fp, agg, sink])
+    else:
+        scan = TableScanOperator(conn, scan_cols)
+        driver = Driver([scan, fp, agg, sink])
+        for s in conn.split_manager().get_splits(table, desired_splits):
+            driver.add_split(s)
+        driver.no_more_splits()
+    return driver, sink
+
+
+def scan_q1_pages(conn: TpchConnector, schema: str = "tiny",
+                  desired_splits: int = 4) -> List[Page]:
+    meta = conn.metadata()
+    table = meta.get_table_handle(schema, "lineitem")
+    cols = {c.name: c for c in meta.get_columns(table)}
+    scan_cols = [cols[n] for n in Q1_COLUMNS]
+    pages = []
+    for s in conn.split_manager().get_splits(table, desired_splits):
+        src = conn.page_source(s, scan_cols)
+        while True:
+            p = src.get_next_page()
+            if p is None:
+                break
+            pages.append(p)
+    return pages
+
+
+def q1_device_step(input_types: List[T.Type]):
+    """A single pure jittable device step: fused filter+project+group-
+    aggregate over one lineitem batch — the flagship kernel for
+    compile-checking (``__graft_entry__.entry``)."""
+    projections, filt, aggs = q1_expressions(input_types)
+    proc = PageProcessor(input_types, projections, filt)
+    out_types = proc.output_types
+    kinds = tuple(k for a in aggs for (k, _) in _state_plan(a))
+
+    def step(cols, nulls, valid, luts):
+        pcols, pnulls, pvalid = proc._run(cols, nulls, valid, luts)
+        key_ops = []
+        for c in (0, 1):
+            key_ops.extend(group_operands(pcols[c], pnulls[c], out_types[c]))
+        key_raws = (pcols[0], pcols[1])
+        state_cols = []
+        for a in aggs:
+            state_cols.extend(_init_states(a, pcols, pnulls, pvalid))
+        return _group_reduce(tuple(key_ops), key_raws, tuple(state_cols),
+                             pvalid, num_keys=2,
+                             num_states=len(state_cols), kinds=kinds)
+
+    return proc, step
+
+
+def q1_example_args(schema: str = "micro"):
+    conn = TpchConnector(page_rows=4096)
+    pages = scan_q1_pages(conn, schema, 1)
+    dp = DevicePage.from_page(pages[0])
+    input_types = dp.types
+    proc, step = q1_device_step(input_types)
+    luts = proc._fill_luts(dp.dictionaries)
+    args = (tuple(dp.cols), tuple(dp.nulls), dp.valid, luts)
+    return step, args

@@ -48,6 +48,19 @@ class Dictionary:
         self._index = {v: i for i, v in enumerate(self.values)}
         self._sort_rank = None
 
+    @classmethod
+    def aligned(cls, values: Sequence[str]) -> "Dictionary":
+        """Pool whose position i maps to values[i] even when values repeat
+        (derived pools from string transforms must stay code-aligned with
+        their source). Lookup maps to the first occurrence."""
+        d = cls.__new__(cls)
+        d.values = list(values)
+        d._index = {}
+        for i, v in enumerate(d.values):
+            d._index.setdefault(v, i)
+        d._sort_rank = None
+        return d
+
     def __len__(self) -> int:
         return len(self.values)
 
@@ -84,13 +97,14 @@ class Dictionary:
         return [vals[c] for c in codes]
 
     def sort_rank(self) -> np.ndarray:
-        """rank[code] = position of values[code] in lexicographic order.
-        Lets ORDER BY on strings run on device: order by rank[codes]."""
+        """rank[code] = DENSE lexicographic rank of values[code]: equal
+        strings get equal rank (aligned pools may repeat values), so device
+        comparisons/grouping over ranks match string equality. Lets ORDER
+        BY / GROUP BY on strings run on device via rank[codes]."""
         if self._sort_rank is None or len(self._sort_rank) != len(self.values):
-            order = np.argsort(np.asarray(self.values, dtype=object), kind="stable")
-            rank = np.empty(len(self.values), dtype=np.int32)
-            rank[order] = np.arange(len(self.values), dtype=np.int32)
-            self._sort_rank = rank
+            _, inverse = np.unique(np.asarray(self.values, dtype=object),
+                                   return_inverse=True)
+            self._sort_rank = inverse.astype(np.int32)
         return self._sort_rank
 
 
@@ -268,6 +282,72 @@ class Page:
                 nulls = None
             blocks.append(Block(t, data, nulls, dictionary))
         return Page(blocks, sum(p.num_rows for p in pages))
+
+
+@dataclass
+class DevicePage:
+    """A page resident on device: padded columns + a live-row mask.
+
+    TPU-first replacement for positional compaction: filtering flips lanes
+    off in ``valid`` instead of gathering survivors, so filter+project+agg
+    chains stay on device with static shapes; compaction happens only at
+    host boundaries (``to_page``) or when an operator chooses to densify.
+
+    - ``cols[i]``: jax array, shape (capacity,), dtype types[i].storage
+    - ``nulls[i]``: jax bool array (True = SQL NULL) — always materialized
+    - ``valid``: jax bool array — lane holds a live row (row-count mask
+      AND any filters applied so far)
+    """
+
+    types: list
+    cols: list
+    nulls: list
+    valid: "jax.Array"  # noqa: F821
+    dictionaries: list  # Optional[Dictionary] per column
+
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+    def count(self) -> int:
+        """Live row count (device sync)."""
+        return int(np.asarray(self.valid).sum())
+
+    @staticmethod
+    def from_page(page: Page, capacity: Optional[int] = None) -> "DevicePage":
+        import jax.numpy as jnp
+
+        n = page.num_rows
+        cap = capacity if capacity is not None else padded_size(n)
+        if cap < n:
+            raise ValueError(
+                f"DevicePage capacity {cap} < page rows {n}")
+        cols, nulls, dicts = [], [], []
+        for b in page.blocks:
+            b = b.numpy()
+            data = np.zeros(cap, dtype=b.type.storage)
+            data[:n] = b.data
+            nl = np.zeros(cap, dtype=bool)
+            if b.nulls is not None:
+                nl[:n] = b.nulls
+            cols.append(jnp.asarray(data))
+            nulls.append(jnp.asarray(nl))
+            dicts.append(b.dictionary)
+        valid = np.zeros(cap, dtype=bool)
+        valid[:n] = True
+        return DevicePage([b.type for b in page.blocks], cols, nulls,
+                          jnp.asarray(valid), dicts)
+
+    def to_page(self) -> Page:
+        """Compact live lanes back to a host Page."""
+        keep = np.nonzero(np.asarray(self.valid))[0]
+        blocks = []
+        for t, c, nl, d in zip(self.types, self.cols, self.nulls,
+                               self.dictionaries):
+            data = np.asarray(c)[keep]
+            nulls = np.asarray(nl)[keep]
+            blocks.append(Block(t, data, nulls if nulls.any() else None, d))
+        return Page(blocks, len(keep))
 
 
 def empty_page(types_: Sequence[T.Type],

@@ -1,0 +1,9 @@
+from .spi import (  # noqa: F401
+    ColumnHandle,
+    Connector,
+    ConnectorMetadata,
+    ConnectorPageSource,
+    ConnectorSplit,
+    ConnectorSplitManager,
+    TableHandle,
+)

@@ -1,0 +1,166 @@
+"""Connector SPI — the pluggable storage boundary.
+
+Reference analog: ``core/trino-spi/src/main/java/io/trino/spi/connector/``
+(~100 interfaces: ConnectorMetadata, ConnectorSplitManager,
+ConnectorPageSource/Sink, ConnectorTableHandle, ...). Compressed to the
+load-bearing surface: metadata CRUD, split enumeration, page sources with
+column pruning + predicate pushdown hooks, page sinks for writes.
+
+TPU-first notes: page sources yield host ``Page``s (numpy + dictionaries);
+the scan operator moves them on device. Splits carry a deterministic
+row-range so distributed scans are reproducible regardless of split count.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Sequence
+
+from .. import types as T
+from ..block import Page
+
+
+@dataclass(frozen=True)
+class ColumnHandle:
+    name: str
+    type: T.Type
+    ordinal: int
+
+
+@dataclass(frozen=True)
+class TableHandle:
+    catalog: str
+    schema: str
+    table: str
+
+    @property
+    def qualified_name(self) -> str:
+        return f"{self.catalog}.{self.schema}.{self.table}"
+
+
+@dataclass(frozen=True)
+class ConnectorSplit:
+    """A unit of scan parallelism (reference: spi/connector/ConnectorSplit).
+    ``row_start``/``row_end`` give deterministic slicing for generators;
+    file-backed connectors may carry opaque ``info`` instead."""
+
+    table: TableHandle
+    split_id: int
+    total_splits: int
+    row_start: int = 0
+    row_end: int = 0
+    info: Optional[dict] = None
+
+
+class ConnectorPageSource:
+    """Pull-based page iterator for one split (reference:
+    spi/connector/ConnectorPageSource.java)."""
+
+    def get_next_page(self) -> Optional[Page]:
+        raise NotImplementedError
+
+    def is_finished(self) -> bool:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+@dataclass
+class TableStatistics:
+    row_count: Optional[float] = None
+    # per-column: distinct count, min, max, null fraction
+    columns: dict = field(default_factory=dict)
+
+
+@dataclass
+class ColumnStatistics:
+    distinct_count: Optional[float] = None
+    null_fraction: float = 0.0
+    min_value: Optional[object] = None
+    max_value: Optional[object] = None
+
+
+class ConnectorMetadata:
+    """Schema browsing + table resolution (reference:
+    spi/connector/ConnectorMetadata.java)."""
+
+    def list_schemas(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_tables(self, schema: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_table_handle(self, schema: str, table: str) -> Optional[TableHandle]:
+        raise NotImplementedError
+
+    def get_columns(self, table: TableHandle) -> List[ColumnHandle]:
+        raise NotImplementedError
+
+    def get_statistics(self, table: TableHandle) -> TableStatistics:
+        return TableStatistics()
+
+
+class ConnectorSplitManager:
+    """Split enumeration (reference: spi/connector/ConnectorSplitManager)."""
+
+    def get_splits(self, table: TableHandle,
+                   desired_splits: int) -> List[ConnectorSplit]:
+        raise NotImplementedError
+
+
+class ConnectorPageSink:
+    """Write path (reference: spi/connector/ConnectorPageSink.java)."""
+
+    def append_page(self, page: Page):
+        raise NotImplementedError
+
+    def finish(self) -> dict:
+        return {}
+
+    def abort(self):
+        pass
+
+
+class Connector:
+    """One catalog's storage engine (reference: spi/connector/Connector.java).
+
+    Subclasses provide metadata/splits/page-sources; ``page_sink`` is
+    optional (read-only connectors raise)."""
+
+    name = "base"
+
+    def metadata(self) -> ConnectorMetadata:
+        raise NotImplementedError
+
+    def split_manager(self) -> ConnectorSplitManager:
+        raise NotImplementedError
+
+    def page_source(self, split: ConnectorSplit,
+                    columns: Sequence[ColumnHandle]) -> ConnectorPageSource:
+        raise NotImplementedError
+
+    def page_sink(self, table: TableHandle,
+                  columns: Sequence[ColumnHandle]) -> ConnectorPageSink:
+        raise T.TrinoError(f"connector {self.name} does not support writes",
+                           "NOT_SUPPORTED")
+
+
+class FixedPageSource(ConnectorPageSource):
+    """Page source over a prebuilt page list (test fixture; reference:
+    spi/connector/FixedPageSource.java)."""
+
+    def __init__(self, pages: Sequence[Page]):
+        self._pages: Iterator[Page] = iter(pages)
+        self._done = False
+        self._next: Optional[Page] = None
+
+    def get_next_page(self) -> Optional[Page]:
+        try:
+            return next(self._pages)
+        except StopIteration:
+            self._done = True
+            return None
+
+    def is_finished(self) -> bool:
+        return self._done

@@ -1,0 +1,78 @@
+"""Driver: the inner execution loop moving pages through an operator chain.
+
+Reference analog: ``operator/Driver.java:380-486`` (processInternal) — walk
+adjacent operator pairs, move one page per iteration, finish-propagate.
+Synchronous for now; the task executor adds cooperative quanta on top
+(reference: execution/executor/TaskExecutor.java).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..connectors.spi import ConnectorSplit
+from ..ops.operator import Operator, SourceOperator
+
+
+class Driver:
+    """Executes one operator chain to completion."""
+
+    def __init__(self, operators: Sequence[Operator]):
+        assert operators, "empty pipeline"
+        self.operators: List[Operator] = list(operators)
+
+    @property
+    def source(self) -> Optional[SourceOperator]:
+        head = self.operators[0]
+        return head if isinstance(head, SourceOperator) else None
+
+    def add_split(self, split: ConnectorSplit):
+        src = self.source
+        assert src is not None, "pipeline has no source operator"
+        src.add_split(split)
+
+    def no_more_splits(self):
+        src = self.source
+        if src is not None:
+            src.no_more_splits()
+
+    def process(self) -> bool:
+        """One scheduling quantum: move pages between adjacent operators.
+        Returns True if the driver is fully finished."""
+        ops = self.operators
+        moved = False
+        for i in range(len(ops) - 1):
+            cur, nxt = ops[i], ops[i + 1]
+            # finish propagation
+            if cur.is_finished() and not nxt._finishing:
+                nxt.finish()
+            if nxt.needs_input():
+                page = cur.get_output()
+                if page is not None:
+                    nxt.add_input(page)
+                    moved = True
+        # drain the tail operator (sinks produce no output)
+        ops[-1].get_output()
+        if not moved:
+            # nothing moved: push finish from the head if it is done
+            if ops[0].is_finished() and not ops[0]._finishing:
+                ops[0].finish()
+        return ops[-1].is_finished()
+
+    def run_to_completion(self, max_quanta: int = 1_000_000):
+        for _ in range(max_quanta):
+            if self.process():
+                return
+        raise RuntimeError("driver did not finish (stuck pipeline?)")
+
+
+class Pipeline:
+    """A driver factory: operator constructors for one pipeline of a task
+    (reference analog: DriverFactory from LocalExecutionPlanner)."""
+
+    def __init__(self, make_operators, is_source: bool = True):
+        self._make = make_operators
+        self.is_source = is_source
+
+    def create_driver(self) -> Driver:
+        return Driver(self._make())

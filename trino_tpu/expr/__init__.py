@@ -1,0 +1,2 @@
+from .ir import Call, InputRef, Literal, RowExpression  # noqa: F401
+from .compiler import PageProcessor  # noqa: F401

@@ -1,0 +1,720 @@
+"""Scalar function registry: type resolution + device kernels.
+
+Reference analog: the builtin function catalog registered in
+``metadata/SystemFunctionBundle.java`` — scalar ops from
+``core/trino-main/src/main/java/io/trino/type/*Operators.java`` (decimal
+type-derivation rules mirrored from ``type/DecimalOperators.java:76,158,239,
+323,503``) and ``operator/scalar/``.
+
+Each function carries:
+- ``resolve(arg_types) -> return type`` (raises TypeError_ on no match)
+- ``kernel(raws, arg_types, ret_type) -> raw`` — traced under jit over raw
+  storage arrays (decimals are scaled int64, dates int32 days, ...)
+- string functions instead carry host-side transforms applied over
+  dictionary values (``str_transform`` for string->string,
+  ``str_scalar`` for string->fixed-width); the compiler turns them into
+  per-code lookup tables gathered on device.
+
+Null propagation is the compiler's job (RETURN_NULL_ON_NULL default);
+kernels see raw lanes and may compute garbage in null lanes (masked out).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..types import TypeError_
+
+
+@dataclass
+class ScalarFunction:
+    name: str
+    resolve: Callable
+    kernel: Optional[Callable] = None
+    str_transform: Optional[Callable] = None   # (*py_args) -> str|None
+    str_scalar: Optional[Callable] = None      # (*py_args) -> python scalar|None
+
+
+REGISTRY: dict = {}
+
+
+def register(fn: ScalarFunction):
+    REGISTRY[fn.name] = fn
+    return fn
+
+
+def get_function(name: str) -> ScalarFunction:
+    f = REGISTRY.get(name)
+    if f is None:
+        raise TypeError_(f"unknown function: {name}")
+    return f
+
+
+# ---------------------------------------------------------------------------
+# helpers
+
+_POW10 = [10 ** i for i in range(19)]
+
+
+def rescale(x, k: int):
+    """x * 10^k (k static python int; negative k divides truncating)."""
+    if k == 0:
+        return x
+    if k > 0:
+        return x * np.int64(_POW10[k])
+    return x // np.int64(_POW10[-k])
+
+
+def div_round_half_up(x, y):
+    """Integer divide rounding half away from zero (reference:
+    DecimalOperators.divideRoundUp)."""
+    sign = jnp.where((x < 0) ^ (y < 0), -1, 1).astype(x.dtype)
+    ax = jnp.abs(x)
+    ay = jnp.abs(y)
+    ay_safe = jnp.where(ay == 0, 1, ay)  # null/error lanes masked upstream
+    q = (2 * ax + ay_safe) // (2 * ay_safe)
+    return sign * q
+
+
+def _is_int(t):
+    return t in (T.TINYINT, T.SMALLINT, T.INTEGER, T.BIGINT)
+
+
+def _is_float(t):
+    return t in (T.REAL, T.DOUBLE)
+
+
+def _as_decimal(t) -> T.DecimalType:
+    """View an integer type as decimal(p, 0) for mixed arithmetic."""
+    if t.is_decimal:
+        return t
+    digits = {T.TINYINT: 3, T.SMALLINT: 5, T.INTEGER: 10, T.BIGINT: 18}[t]
+    return T.decimal_type(digits, 0)
+
+
+def _numeric_pair(a, b):
+    """Classify a binary numeric op: 'float' | 'decimal' | 'int'."""
+    if _is_float(a) or _is_float(b):
+        return "float"
+    if a.is_decimal or b.is_decimal:
+        return "decimal"
+    if _is_int(a) and _is_int(b):
+        return "int"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# arithmetic
+
+
+def _resolve_add_sub(args):
+    a, b = args
+    kind = _numeric_pair(a, b)
+    if kind == "float":
+        return T.DOUBLE if T.DOUBLE in (a, b) else T.REAL
+    if kind == "int":
+        return T.common_super_type(a, b)
+    if kind == "decimal":
+        da, db = _as_decimal(a), _as_decimal(b)
+        s = max(da.scale, db.scale)
+        p = min(18, max(da.precision - da.scale, db.precision - db.scale) + s + 1)
+        return T.decimal_type(p, s)
+    # date/timestamp +- interval
+    if a in (T.DATE, T.TIMESTAMP) and b in (T.INTERVAL_DAY_SECOND,
+                                            T.INTERVAL_YEAR_MONTH):
+        return a
+    if b in (T.DATE, T.TIMESTAMP) and a in (T.INTERVAL_DAY_SECOND,
+                                            T.INTERVAL_YEAR_MONTH):
+        return b
+    raise TypeError_(f"cannot add/subtract {a} and {b}")
+
+
+def _date_plus_interval(val, ival, itype, sign):
+    if itype == T.INTERVAL_DAY_SECOND:
+        days = ival // np.int64(86_400_000_000)
+        return (val + sign * days).astype(jnp.int32)
+    # year-month: civil-calendar month addition
+    y, m, d = _civil_from_days(val)
+    months = (y * 12 + (m - 1)) + sign * ival
+    ny = jnp.floor_divide(months, 12)
+    nm = months - ny * 12 + 1
+    # clamp day to last day of target month
+    last = _days_in_month(ny, nm)
+    nd = jnp.minimum(d, last)
+    return _days_from_civil(ny, nm, nd).astype(jnp.int32)
+
+
+def _to_float(x, t):
+    if t.is_decimal:
+        return x.astype(jnp.float64) / _POW10[t.scale]
+    return x.astype(jnp.float64)
+
+
+def coerce_raw(x, t, ret):
+    """Convert raw storage of type t to raw storage of type ret."""
+    if t == ret:
+        return x
+    if ret.is_decimal:
+        if _is_float(t):
+            return (x.astype(jnp.float64) * _POW10[ret.scale]).astype(jnp.int64)
+        return rescale(x.astype(jnp.int64), ret.scale - _as_decimal(t).scale)
+    if _is_float(ret):
+        return _to_float(x, t).astype(ret.storage)
+    if t.is_decimal:  # decimal -> int: truncate toward zero
+        s = np.int64(_POW10[t.scale])
+        return (jnp.sign(x) * (jnp.abs(x) // s)).astype(ret.storage)
+    return x.astype(ret.storage)
+
+
+def _add_sub_kernel(sign):
+    def kernel(raws, arg_types, ret_type):
+        a, b = raws
+        ta, tb = arg_types
+        if tb in (T.DATE, T.TIMESTAMP):  # interval + date => date + interval
+            a, b, ta, tb = b, a, tb, ta
+        if ta in (T.DATE, T.TIMESTAMP) and tb in (T.INTERVAL_DAY_SECOND,
+                                                  T.INTERVAL_YEAR_MONTH):
+            if ta == T.TIMESTAMP:
+                if tb == T.INTERVAL_DAY_SECOND:
+                    return a + sign * b
+                days = _date_plus_interval(
+                    (a // np.int64(86_400_000_000)).astype(jnp.int32),
+                    b, tb, sign)
+                return days.astype(jnp.int64) * np.int64(86_400_000_000) \
+                    + a % np.int64(86_400_000_000)
+            return _date_plus_interval(a, b, tb, sign)
+        if ta == T.DATE and tb == T.DATE and sign == -1:
+            return (a.astype(jnp.int64) - b.astype(jnp.int64))
+        return coerce_raw(a, ta, ret_type) + sign * coerce_raw(b, tb, ret_type)
+
+    return kernel
+
+
+register(ScalarFunction("add", _resolve_add_sub, _add_sub_kernel(1)))
+register(ScalarFunction("subtract", _resolve_add_sub, _add_sub_kernel(-1)))
+
+
+def _resolve_mul(args):
+    a, b = args
+    kind = _numeric_pair(a, b)
+    if kind == "float":
+        return T.DOUBLE if T.DOUBLE in (a, b) else T.REAL
+    if kind == "int":
+        return T.common_super_type(a, b)
+    if kind == "decimal":
+        da, db = _as_decimal(a), _as_decimal(b)
+        return T.decimal_type(min(18, da.precision + db.precision),
+                              da.scale + db.scale)
+    if a == T.INTERVAL_DAY_SECOND and _is_int(b):
+        return a
+    raise TypeError_(f"cannot multiply {a} and {b}")
+
+
+def _mul_kernel(raws, arg_types, ret_type):
+    a, b = raws
+    ta, tb = arg_types
+    if _is_float(ret_type):
+        return (_to_float(a, ta) * _to_float(b, tb)).astype(ret_type.storage)
+    if ret_type.is_decimal:
+        return a.astype(jnp.int64) * b.astype(jnp.int64)
+    return (a.astype(ret_type.storage)) * (b.astype(ret_type.storage))
+
+
+register(ScalarFunction("multiply", _resolve_mul, _mul_kernel))
+
+
+def _resolve_div(args):
+    a, b = args
+    kind = _numeric_pair(a, b)
+    if kind == "float":
+        return T.DOUBLE if T.DOUBLE in (a, b) else T.REAL
+    if kind == "int":
+        return T.common_super_type(a, b)
+    if kind == "decimal":
+        da, db = _as_decimal(a), _as_decimal(b)
+        # reference: DecimalOperators.java:323-324
+        p = min(18, da.precision + db.scale + max(db.scale - da.scale, 0))
+        s = max(da.scale, db.scale)
+        return T.decimal_type(p, s)
+    raise TypeError_(f"cannot divide {a} and {b}")
+
+
+def _div_kernel(raws, arg_types, ret_type):
+    a, b = raws
+    ta, tb = arg_types
+    if _is_float(ret_type):
+        return (_to_float(a, ta) / _to_float(b, tb)).astype(ret_type.storage)
+    if ret_type.is_decimal:
+        da, db = _as_decimal(ta), _as_decimal(tb)
+        # rescaleFactor = resultScale - dividendScale + divisorScale
+        k = ret_type.scale - da.scale + db.scale
+        return div_round_half_up(rescale(a.astype(jnp.int64), k),
+                                 b.astype(jnp.int64))
+    bz = jnp.where(b == 0, 1, b)
+    return (a.astype(ret_type.storage)) // (bz.astype(ret_type.storage))
+
+
+register(ScalarFunction("divide", _resolve_div, _div_kernel))
+
+
+def _resolve_mod(args):
+    a, b = args
+    kind = _numeric_pair(a, b)
+    if kind == "float":
+        return T.DOUBLE if T.DOUBLE in (a, b) else T.REAL
+    if kind == "int":
+        return T.common_super_type(a, b)
+    if kind == "decimal":
+        da, db = _as_decimal(a), _as_decimal(b)
+        # reference: DecimalOperators.java:503-504
+        s = max(da.scale, db.scale)
+        p = min(db.precision - db.scale, da.precision - da.scale) + s
+        return T.decimal_type(min(18, p), s)
+    raise TypeError_(f"cannot mod {a} and {b}")
+
+
+def _mod_kernel(raws, arg_types, ret_type):
+    a, b = raws
+    ta, tb = arg_types
+    if _is_float(ret_type):
+        return jnp.fmod(_to_float(a, ta), _to_float(b, tb)).astype(ret_type.storage)
+    if ret_type.is_decimal:
+        da, db = _as_decimal(ta), _as_decimal(tb)
+        s = ret_type.scale
+        ra = rescale(a.astype(jnp.int64), s - da.scale)
+        rb = rescale(b.astype(jnp.int64), s - db.scale)
+        rbz = jnp.where(rb == 0, 1, rb)
+        return ra - (jnp.sign(ra) * (jnp.abs(ra) // jnp.abs(rbz))) * rbz
+    bz = jnp.where(b == 0, 1, b)
+    # SQL mod takes dividend sign (fmod), not python floor-mod
+    q = jnp.sign(a) * (jnp.abs(a) // jnp.abs(bz.astype(a.dtype)))
+    return (a - q * bz.astype(a.dtype)).astype(ret_type.storage)
+
+
+register(ScalarFunction("modulus", _resolve_mod, _mod_kernel))
+register(ScalarFunction("mod", _resolve_mod, _mod_kernel))
+
+
+def _resolve_negate(args):
+    (a,) = args
+    if _is_int(a) or _is_float(a) or a.is_decimal or a in (
+            T.INTERVAL_DAY_SECOND, T.INTERVAL_YEAR_MONTH):
+        return a
+    raise TypeError_(f"cannot negate {a}")
+
+
+register(ScalarFunction("negate", _resolve_negate,
+                        lambda raws, at, rt: -raws[0]))
+
+
+# ---------------------------------------------------------------------------
+# comparisons (numeric / date / boolean; string comparisons are routed
+# through dictionary rank LUTs by the compiler, not this kernel)
+
+
+def _resolve_cmp(args):
+    a, b = args
+    if a == b or T.common_super_type(a, b) is not None:
+        return T.BOOLEAN
+    raise TypeError_(f"cannot compare {a} and {b}")
+
+
+def _cmp_kernel(op):
+    def kernel(raws, arg_types, ret_type):
+        a, b = raws
+        ta, tb = arg_types
+        if ta.is_decimal or tb.is_decimal:
+            if _is_float(ta) or _is_float(tb):
+                a, b = _to_float(a, ta), _to_float(b, tb)
+            else:
+                da, db = _as_decimal(ta), _as_decimal(tb)
+                s = max(da.scale, db.scale)
+                a = rescale(a.astype(jnp.int64), s - da.scale)
+                b = rescale(b.astype(jnp.int64), s - db.scale)
+        elif _is_float(ta) or _is_float(tb):
+            a, b = _to_float(a, ta), _to_float(b, tb)
+        return op(a, b)
+
+    return kernel
+
+
+for _n, _op in [("eq", jnp.equal), ("ne", jnp.not_equal), ("lt", jnp.less),
+                ("le", jnp.less_equal), ("gt", jnp.greater),
+                ("ge", jnp.greater_equal)]:
+    register(ScalarFunction(_n, _resolve_cmp, _cmp_kernel(_op)))
+
+
+# ---------------------------------------------------------------------------
+# math
+
+
+def _resolve_unary_double(args):
+    (a,) = args
+    if _is_int(a) or _is_float(a) or a.is_decimal:
+        return T.DOUBLE
+    raise TypeError_(f"expected numeric, got {a}")
+
+
+def _unary_double(fn):
+    return lambda raws, at, rt: fn(_to_float(raws[0], at[0]))
+
+
+register(ScalarFunction("sqrt", _resolve_unary_double, _unary_double(jnp.sqrt)))
+register(ScalarFunction("ln", _resolve_unary_double, _unary_double(jnp.log)))
+register(ScalarFunction("log10", _resolve_unary_double, _unary_double(jnp.log10)))
+register(ScalarFunction("exp", _resolve_unary_double, _unary_double(jnp.exp)))
+register(ScalarFunction("sin", _resolve_unary_double, _unary_double(jnp.sin)))
+register(ScalarFunction("cos", _resolve_unary_double, _unary_double(jnp.cos)))
+register(ScalarFunction("tan", _resolve_unary_double, _unary_double(jnp.tan)))
+
+
+def _resolve_same(args):
+    (a,) = args
+    if _is_int(a) or _is_float(a) or a.is_decimal:
+        return a
+    raise TypeError_(f"expected numeric, got {a}")
+
+
+register(ScalarFunction("abs", _resolve_same,
+                        lambda raws, at, rt: jnp.abs(raws[0])))
+
+
+def _resolve_power(args):
+    a, b = args
+    if _numeric_pair(a, b):
+        return T.DOUBLE
+    raise TypeError_(f"cannot power {a}, {b}")
+
+
+register(ScalarFunction(
+    "power", _resolve_power,
+    lambda raws, at, rt: jnp.power(_to_float(raws[0], at[0]),
+                                   _to_float(raws[1], at[1]))))
+register(ScalarFunction(
+    "pow", _resolve_power,
+    lambda raws, at, rt: jnp.power(_to_float(raws[0], at[0]),
+                                   _to_float(raws[1], at[1]))))
+
+
+def _resolve_round(args):
+    a = args[0]
+    if len(args) == 2 and not _is_int(args[1]):
+        raise TypeError_("round() scale must be integer")
+    if a.is_decimal:
+        if len(args) == 2:
+            # round(decimal, n) keeps the type (digits beyond n zeroed)
+            return a
+        return T.decimal_type(min(18, a.precision - a.scale + 1), 0)
+    if _is_int(a) or _is_float(a):
+        return a
+    raise TypeError_(f"cannot round {a}")
+
+
+def _round_kernel(raws, arg_types, ret_type):
+    a = raws[0]
+    ta = arg_types[0]
+    if _is_float(ta):
+        if len(raws) == 2:
+            f = jnp.power(10.0, raws[1].astype(jnp.float64))
+            # SQL rounds half away from zero (not banker's rounding)
+            return (jnp.sign(a) * jnp.floor(jnp.abs(a) * f + 0.5) / f).astype(ta.storage)
+        return (jnp.sign(a) * jnp.floor(jnp.abs(a) + 0.5)).astype(ta.storage)
+    if ta.is_decimal:
+        if len(raws) == 1:
+            return div_round_half_up(a, np.int64(_POW10[ta.scale]))
+        # round(decimal, n): zero out digits beyond scale n (n runtime value)
+        k = jnp.clip(ta.scale - raws[1].astype(jnp.int64), 0, 18)
+        f = jnp.asarray(_POW10, dtype=jnp.int64)[k]
+        return div_round_half_up(a, f) * f
+    return a
+
+
+register(ScalarFunction("round", _resolve_round, _round_kernel))
+
+
+def _resolve_floor_ceil(args):
+    (a,) = args
+    if a.is_decimal:
+        return T.decimal_type(min(18, a.precision - a.scale + 1), 0)
+    if _is_int(a) or _is_float(a):
+        return a
+    raise TypeError_(f"cannot floor/ceil {a}")
+
+
+def _floor_kernel(raws, arg_types, ret_type):
+    a, ta = raws[0], arg_types[0]
+    if ta.is_decimal:
+        return jnp.floor_divide(a, np.int64(_POW10[ta.scale]))
+    if _is_float(ta):
+        return jnp.floor(a)
+    return a
+
+
+def _ceil_kernel(raws, arg_types, ret_type):
+    a, ta = raws[0], arg_types[0]
+    if ta.is_decimal:
+        return -jnp.floor_divide(-a, np.int64(_POW10[ta.scale]))
+    if _is_float(ta):
+        return jnp.ceil(a)
+    return a
+
+
+register(ScalarFunction("floor", _resolve_floor_ceil, _floor_kernel))
+register(ScalarFunction("ceil", _resolve_floor_ceil, _ceil_kernel))
+register(ScalarFunction("ceiling", _resolve_floor_ceil, _ceil_kernel))
+
+
+def _resolve_greatest(args):
+    t = args[0]
+    for a in args[1:]:
+        t2 = T.common_super_type(t, a)
+        if t2 is None:
+            raise TypeError_(f"greatest/least mixed types {t}, {a}")
+        t = t2
+    return t
+
+
+def _minmax_kernel(jfn):
+    def kernel(raws, arg_types, ret_type):
+        acc = None
+        for r, t in zip(raws, arg_types):
+            if ret_type.is_decimal:
+                v = rescale(r.astype(jnp.int64), ret_type.scale - _as_decimal(t).scale)
+            elif _is_float(ret_type):
+                v = _to_float(r, t)
+            else:
+                v = r.astype(ret_type.storage)
+            acc = v if acc is None else jfn(acc, v)
+        return acc
+
+    return kernel
+
+
+register(ScalarFunction("greatest", _resolve_greatest, _minmax_kernel(jnp.maximum)))
+register(ScalarFunction("least", _resolve_greatest, _minmax_kernel(jnp.minimum)))
+
+
+# ---------------------------------------------------------------------------
+# date / time (civil calendar math; Howard Hinnant's algorithms —
+# vectorized integer ops, MXU/VPU friendly, no host round-trip)
+
+
+def _civil_from_days(days):
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(
+        doe - doe // 1460 + doe // 36524 - doe // 146096, 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+def _days_in_month(y, m):
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    lengths = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                          dtype=jnp.int64)
+    base = lengths[m - 1]
+    return jnp.where((m == 2) & leap, 29, base)
+
+
+def days_from_civil_host(y: int, m: int, d: int) -> int:
+    import datetime
+    return datetime.date(y, m, d).toordinal() - datetime.date(1970, 1, 1).toordinal()
+
+
+def _resolve_date_part(args):
+    (a,) = args
+    if a in (T.DATE, T.TIMESTAMP):
+        return T.BIGINT
+    raise TypeError_(f"expected date/timestamp, got {a}")
+
+
+def _to_days(raw, t):
+    if t == T.TIMESTAMP:
+        return jnp.floor_divide(raw, np.int64(86_400_000_000)).astype(jnp.int32)
+    return raw
+
+
+def _date_part_kernel(part):
+    def kernel(raws, arg_types, ret_type):
+        days = _to_days(raws[0], arg_types[0])
+        y, m, d = _civil_from_days(days)
+        if part == "year":
+            return y
+        if part == "month":
+            return m
+        if part == "day":
+            return d
+        if part == "quarter":
+            return (m - 1) // 3 + 1
+        if part == "day_of_week":  # ISO: Mon=1..Sun=7 (1970-01-01 = Thursday)
+            return ((days.astype(jnp.int64) + 3) % 7) + 1
+        if part == "day_of_year":
+            jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+            return days.astype(jnp.int64) - jan1 + 1
+        if part == "week":  # ISO week number via the Thursday rule
+            dow = (days.astype(jnp.int64) + 3) % 7  # Mon=0..Sun=6
+            thursday = days.astype(jnp.int64) + (3 - dow)
+            ty, tm, td = _civil_from_days(thursday.astype(jnp.int32))
+            jan1 = _days_from_civil(ty, jnp.ones_like(tm), jnp.ones_like(td))
+            return (thursday - jan1) // 7 + 1
+        raise TypeError_(f"unsupported extract field {part}")
+
+    return kernel
+
+
+for _p in ["year", "month", "day", "quarter", "day_of_week", "day_of_year",
+           "week"]:
+    register(ScalarFunction(f"$extract_{_p}", _resolve_date_part,
+                            _date_part_kernel(_p)))
+register(ScalarFunction("year", _resolve_date_part, _date_part_kernel("year")))
+register(ScalarFunction("month", _resolve_date_part, _date_part_kernel("month")))
+register(ScalarFunction("day", _resolve_date_part, _date_part_kernel("day")))
+register(ScalarFunction("quarter", _resolve_date_part, _date_part_kernel("quarter")))
+
+
+def _resolve_date_diff(args):
+    raise TypeError_("date_diff requires literal unit (handled by analyzer)")
+
+
+# ---------------------------------------------------------------------------
+# string functions (host dictionary transforms; compiler wires LUTs)
+
+
+def _resolve_strlen(args):
+    (a,) = args
+    if a.is_string:
+        return T.BIGINT
+    raise TypeError_(f"length() expects varchar, got {a}")
+
+
+register(ScalarFunction("length", _resolve_strlen,
+                        str_scalar=lambda s: len(s)))
+
+
+def _resolve_str_to_str(nargs_ok):
+    def resolve(args):
+        if not args[0].is_string:
+            raise TypeError_(f"expected varchar, got {args[0]}")
+        if not nargs_ok(len(args)):
+            raise TypeError_("wrong argument count")
+        return T.VARCHAR
+
+    return resolve
+
+
+register(ScalarFunction("lower", _resolve_str_to_str(lambda n: n == 1),
+                        str_transform=lambda s: s.lower()))
+register(ScalarFunction("upper", _resolve_str_to_str(lambda n: n == 1),
+                        str_transform=lambda s: s.upper()))
+register(ScalarFunction("trim", _resolve_str_to_str(lambda n: n == 1),
+                        str_transform=lambda s: s.strip()))
+register(ScalarFunction("ltrim", _resolve_str_to_str(lambda n: n == 1),
+                        str_transform=lambda s: s.lstrip()))
+register(ScalarFunction("rtrim", _resolve_str_to_str(lambda n: n == 1),
+                        str_transform=lambda s: s.rstrip()))
+register(ScalarFunction("reverse", _resolve_str_to_str(lambda n: n == 1),
+                        str_transform=lambda s: s[::-1]))
+
+
+def _substr(s, start, length=None):
+    # SQL substr: 1-based; 0 treated as 1; negative counts from end
+    start = int(start)
+    if start == 0:
+        start = 1
+    if start > 0:
+        i = start - 1
+    else:
+        i = len(s) + start
+        if i < 0:
+            i = 0
+    if length is None:
+        return s[i:]
+    return s[i:i + int(length)]
+
+
+def _resolve_substr(args):
+    if not args[0].is_string:
+        raise TypeError_(f"substr expects varchar, got {args[0]}")
+    for a in args[1:]:
+        if not _is_int(a):
+            raise TypeError_("substr offsets must be integers")
+    return T.VARCHAR
+
+
+register(ScalarFunction("substr", _resolve_substr, str_transform=_substr))
+register(ScalarFunction("substring", _resolve_substr, str_transform=_substr))
+
+
+def _resolve_concat(args):
+    for a in args:
+        if not a.is_string:
+            raise TypeError_(f"concat expects varchar, got {a}")
+    return T.VARCHAR
+
+
+register(ScalarFunction("concat", _resolve_concat,
+                        str_transform=lambda *parts: "".join(parts)))
+
+
+def like_to_regex(pattern: str, escape: Optional[str] = None) -> "re.Pattern":
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if escape and c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("^" + "".join(out) + "$", re.DOTALL)
+
+
+def _resolve_strpos(args):
+    if not (args[0].is_string and args[1].is_string):
+        raise TypeError_("strpos expects (varchar, varchar)")
+    return T.BIGINT
+
+
+register(ScalarFunction("strpos", _resolve_strpos,
+                        str_scalar=lambda s, sub: s.find(sub) + 1))
+register(ScalarFunction(
+    "starts_with", lambda args: T.BOOLEAN,
+    str_scalar=lambda s, pre: s.startswith(pre)))
+register(ScalarFunction(
+    "replace", _resolve_str_to_str(lambda n: n in (2, 3)),
+    str_transform=lambda s, find, repl="": s.replace(find, repl)))
+register(ScalarFunction(
+    "lpad", _resolve_str_to_str(lambda n: n == 3),
+    str_transform=lambda s, n, pad: s.rjust(int(n), pad[:1] or " ")[:int(n)]))
+register(ScalarFunction(
+    "rpad", _resolve_str_to_str(lambda n: n == 3),
+    str_transform=lambda s, n, pad: s.ljust(int(n), pad[:1] or " ")[:int(n)]))

@@ -1,0 +1,371 @@
+"""Hash joins, TPU-first.
+
+Reference analog: ``operator/join/HashBuilderOperator.java`` (build side:
+PagesIndex + JoinHash open-addressing) + ``LookupJoinOperator.java`` /
+``JoinProbe`` (probe side), plus ``SetBuilderOperator``/``ChannelSet`` for
+semi joins.
+
+TPU redesign: open-addressing probes are scatter/gather-chase loops that
+map poorly to XLA. Instead the build side becomes a **sorted index**: key
+columns normalize to uint64 (exact for single keys; packed or hashed for
+multi-key), ``lax.sort`` orders the build rows, and probing is two
+``searchsorted`` calls (XLA-native vectorized binary search) giving each
+probe row its candidate range. Matches expand via cumsum offsets into a
+static-capacity output (host reads the exact total first — one scalar
+sync), and candidates are verified against the raw key columns, so hash
+collisions cost only capacity, never correctness. Unmatched-probe lanes
+for LEFT/ANTI come from a segment-OR over verified matches.
+
+Two-operator split with a JoinBridge mirrors the reference; the physical
+planner runs the build pipeline to completion before the probe pipeline.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..block import DevicePage, padded_size
+from .operator import Operator
+from .sortkeys import group_operands
+
+
+def _key_u64(cols, nulls, types_, mode: str) -> Tuple:
+    """(key_u64, any_null): combined uint64 join key per row.
+
+    mode (STATIC, decided once on the build side and shared via the
+    bridge so both sides encode identically):
+    - 'single': one key, exact order-preserving u64
+    - 'packed': two keys, both known to fit 32 bits — exact pack
+    - 'hashed': splitmix-combined (collisions verified against raw keys)
+    """
+    ops = []
+    anynull = None
+    for c, nl, t in zip(cols, nulls, types_):
+        null_bit, key = group_operands(c, nl, t)
+        if key.dtype == jnp.float64:
+            # float join keys: frexp-based u64 (no f64 bitcast on TPU);
+            # 2 dropped mantissa bits => rare extra candidates, all
+            # filtered by the raw-key verify pass
+            m, e = jnp.frexp(key)
+            mant = (jnp.abs(m) * np.float64(1 << 53)).astype(jnp.int64) >> 2
+            sign = (key < 0).astype(jnp.int64)
+            key = (((e.astype(jnp.int64) + 1100) << np.int64(52))
+                   | mant | (sign << np.int64(63))).view(jnp.uint64)
+        ops.append(key)
+        anynull = null_bit.astype(bool) if anynull is None \
+            else (anynull | null_bit.astype(bool))
+    if mode == "single":
+        return ops[0], anynull
+    if mode == "packed":
+        hi, lo = ops[0], ops[1]
+        return (hi << np.uint64(32)) | (lo & np.uint64(0xFFFFFFFF)), anynull
+    return _hash_combine(ops), anynull
+
+
+def choose_key_mode(key_cols_u64_max_bits: int, num_keys: int) -> str:
+    if num_keys == 1:
+        return "single"
+    if num_keys == 2 and key_cols_u64_max_bits <= 32:
+        return "packed"
+    return "hashed"
+
+
+def _hash_combine(ops):
+    acc = jnp.zeros(ops[0].shape, dtype=jnp.uint64)
+    for k in ops:
+        z = (k + np.uint64(0x9E3779B97F4A7C15)) * np.uint64(0xBF58476D1CE4E5B9)
+        z = z ^ (z >> np.uint64(29))
+        acc = (acc * np.uint64(31)) ^ z
+    return acc
+
+
+@jax.jit
+def _build_sorted(key_u64, anynull, cols, nulls, valid):
+    """Sort the build rows by key; null-key or invalid lanes sort last."""
+    usable = valid & ~anynull if anynull is not None else valid
+    sort_key = jnp.where(usable, key_u64, np.uint64(0xFFFFFFFFFFFFFFFF))
+    operands = [sort_key, usable] + list(cols) + list(nulls)
+    s = jax.lax.sort(operands, num_keys=1, is_stable=False)
+    n = len(cols)
+    return s[0], s[1], tuple(s[2:2 + n]), tuple(s[2 + n:])
+
+
+@jax.jit
+def _probe_counts(build_keys, build_usable, probe_keys, probe_usable):
+    lo = jnp.searchsorted(build_keys, probe_keys, side="left")
+    hi = jnp.searchsorted(build_keys, probe_keys, side="right")
+    count = jnp.where(probe_usable, hi - lo, 0)
+    return lo, count
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _expand_matches(lo, count, out_cap: int):
+    """Candidate pairs: output lane j -> (probe_row, build_row)."""
+    off_end = jnp.cumsum(count)
+    total = off_end[-1]
+    j = jnp.arange(out_cap, dtype=jnp.int64)
+    probe_idx = jnp.searchsorted(off_end, j, side="right")
+    probe_idx = jnp.clip(probe_idx, 0, count.shape[0] - 1)
+    start = off_end[probe_idx] - count[probe_idx]
+    build_idx = lo[probe_idx] + (j - start)
+    lane_valid = j < total
+    return (probe_idx.astype(jnp.int32),
+            jnp.clip(build_idx, 0, None).astype(jnp.int32), lane_valid)
+
+
+@dataclass
+class BuildSide:
+    key_sorted: "jax.Array"
+    usable_sorted: "jax.Array"
+    cols: Tuple
+    nulls: Tuple
+    types: List
+    dictionaries: List
+    key_channels: List
+    key_mode: str = "single"
+
+
+class JoinBridge:
+    """Hand-off from the build pipeline to the probe pipeline (reference:
+    operator/join/JoinBridge.java / PartitionedLookupSourceFactory)."""
+
+    def __init__(self):
+        self.build: Optional[BuildSide] = None
+
+    def set_build(self, b: BuildSide):
+        self.build = b
+
+
+class HashBuilderOperator(Operator):
+    """Accumulates the build side and publishes a sorted index."""
+
+    def __init__(self, input_types: Sequence[T.Type],
+                 key_channels: Sequence[int], bridge: JoinBridge):
+        self.input_types = list(input_types)
+        self.key_channels = list(key_channels)
+        self.bridge = bridge
+        self._pages: List[DevicePage] = []
+        self._done = False
+
+    def add_input(self, page: DevicePage):
+        self._pages.append(page)
+
+    def get_output(self):
+        if self._finishing and not self._done:
+            self._publish()
+            self._done = True
+        return None
+
+    def _publish(self):
+        if self._pages:
+            cap = padded_size(sum(p.capacity for p in self._pages))
+            cols, nulls = [], []
+            nch = len(self.input_types)
+            for i in range(nch):
+                cols.append(_pad_concat([p.cols[i] for p in self._pages], cap))
+                nulls.append(_pad_concat([p.nulls[i] for p in self._pages],
+                                         cap, fill=True))
+            valid = _pad_concat([p.valid for p in self._pages], cap)
+            dicts = self._unified_dicts()
+        else:
+            cap = 16
+            cols = [jnp.zeros(cap, dtype=t.storage) for t in self.input_types]
+            nulls = [jnp.ones(cap, dtype=bool) for _ in self.input_types]
+            valid = jnp.zeros(cap, dtype=bool)
+            dicts = [None] * len(self.input_types)
+        kc = self.key_channels
+        key_types = [self.input_types[c] for c in kc]
+        mode = "single" if len(kc) == 1 else "hashed"
+        if len(kc) == 2:
+            # host decision (one sync at build publish): exact 32-bit pack?
+            bits = 0
+            for c, t in zip(kc, key_types):
+                ops = group_operands(cols[c], nulls[c], t)
+                mx = int(jnp.max(jnp.where(valid, ops[1], np.uint64(0))))
+                bits = max(bits, mx.bit_length())
+            mode = choose_key_mode(bits, 2)
+        key, anynull = _key_u64([cols[c] for c in kc],
+                                [nulls[c] for c in kc], key_types, mode)
+        ks, us, scols, snulls = _build_sorted(
+            key, anynull if anynull is not None
+            else jnp.zeros(cap, dtype=bool), tuple(cols), tuple(nulls),
+            valid)
+        self.bridge.set_build(BuildSide(ks, us, scols, snulls,
+                                        self.input_types, dicts, kc, mode))
+
+    def _unified_dicts(self):
+        dicts = [None] * len(self.input_types)
+        for p in self._pages:
+            for i, d in enumerate(p.dictionaries):
+                if d is not None:
+                    if dicts[i] is None:
+                        dicts[i] = d
+                    elif dicts[i] is not d:
+                        raise T.TrinoError(
+                            "build-side dictionary pools differ across "
+                            "pages; scan pools must be stable",
+                            "GENERIC_INTERNAL_ERROR")
+        return dicts
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class LookupJoinOperator(Operator):
+    """Probe side. join_type: inner | left | semi | anti.
+
+    Output layout: all probe channels, then (inner/left) all build channels
+    — build channels NULL on unmatched left rows. semi/anti emit probe
+    channels only.
+    """
+
+    def __init__(self, probe_types: Sequence[T.Type],
+                 probe_key_channels: Sequence[int], bridge: JoinBridge,
+                 join_type: str = "inner",
+                 filter_fn=None):
+        assert join_type in ("inner", "left", "semi", "anti")
+        self.probe_types = list(probe_types)
+        self.probe_keys = list(probe_key_channels)
+        self.bridge = bridge
+        self.join_type = join_type
+        self.filter_fn = filter_fn  # optional post-join residual filter
+        self._pending: Optional[DevicePage] = None
+        self._done = False
+
+    @property
+    def output_types(self) -> List[T.Type]:
+        b = self.bridge.build
+        if self.join_type in ("semi", "anti"):
+            return list(self.probe_types)
+        return list(self.probe_types) + list(b.types)
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, page: DevicePage):
+        self._pending = self._join_page(page)
+
+    def get_output(self):
+        out, self._pending = self._pending, None
+        if out is None and self._finishing:
+            self._done = True
+        return out
+
+    def is_finished(self) -> bool:
+        return self._done
+
+    def _join_page(self, page: DevicePage) -> DevicePage:
+        b = self.bridge.build
+        assert b is not None, "probe started before build finished"
+        kc = self.probe_keys
+        pkey, panynull = _key_u64([page.cols[c] for c in kc],
+                                  [page.nulls[c] for c in kc],
+                                  [self.probe_types[c] for c in kc],
+                                  b.key_mode)
+        pusable = page.valid & ~panynull if panynull is not None \
+            else page.valid
+
+        if self.join_type in ("semi", "anti"):
+            lo, count = _probe_counts(b.key_sorted, b.usable_sorted, pkey,
+                                      pusable)
+            total = int(jnp.sum(count))
+            cap = padded_size(max(total, 16))
+            matched = _semi_matched(
+                lo, count,
+                tuple(page.cols[c] for c in kc),
+                tuple(b.cols[c] for c in b.key_channels),
+                page.valid.shape[0], out_cap=cap)
+            if self.join_type == "semi":
+                new_valid = page.valid & matched
+            else:
+                new_valid = page.valid & ~matched
+            return DevicePage(page.types, page.cols, page.nulls, new_valid,
+                              page.dictionaries)
+
+        lo, count = _probe_counts(b.key_sorted, b.usable_sorted, pkey,
+                                  pusable)
+        total = int(jnp.max(jnp.cumsum(count)))  # device sync: exact size
+        extra = page.capacity if self.join_type == "left" else 0
+        out_cap = padded_size(max(total + extra, 16))
+        out = _emit_join(
+            tuple(page.cols), tuple(page.nulls), page.valid,
+            tuple(b.cols), tuple(b.nulls),
+            tuple(page.cols[c] for c in kc),
+            tuple(b.cols[c] for c in b.key_channels),
+            lo, count, pusable,
+            out_cap=out_cap, left=self.join_type == "left")
+        out_cols, out_nulls, out_valid = out
+        types = self.output_types
+        dicts = list(page.dictionaries) + list(b.dictionaries)
+        result = DevicePage(types, list(out_cols), list(out_nulls),
+                            out_valid, dicts)
+        if self.filter_fn is not None:
+            result = self.filter_fn(result)
+        return result
+
+
+@partial(jax.jit, static_argnames=("out_cap", "left"))
+def _emit_join(pcols, pnulls, pvalid, bcols, bnulls, pkey_cols, bkey_cols,
+               lo, count, pusable, out_cap: int, left: bool):
+    probe_idx, build_idx, lane_valid = _expand_matches(lo, count, out_cap)
+    # verify candidates against raw keys (hash collisions -> drop lane)
+    keep = lane_valid
+    for pc, bc in zip(pkey_cols, bkey_cols):
+        keep = keep & (pc[probe_idx] == bc[build_idx])
+    if left:
+        # matched probe rows: OR of keep per probe row
+        matched = jnp.zeros(pvalid.shape[0] + 1, dtype=bool)
+        matched = matched.at[jnp.where(keep, probe_idx, pvalid.shape[0])] \
+            .max(True)
+        matched = matched[:-1]
+        # append one lane per unmatched live probe row
+        n_extra = pvalid.shape[0]
+        extra_probe = jnp.arange(n_extra, dtype=jnp.int32)
+        extra_valid = pvalid & ~matched
+        probe_idx = jnp.concatenate([probe_idx[:out_cap - n_extra],
+                                     extra_probe])
+        keep = jnp.concatenate([keep[:out_cap - n_extra], extra_valid])
+        build_is_null = jnp.concatenate(
+            [jnp.zeros(out_cap - n_extra, dtype=bool),
+             jnp.ones(n_extra, dtype=bool)])
+        build_idx = jnp.concatenate([build_idx[:out_cap - n_extra],
+                                     jnp.zeros(n_extra, dtype=jnp.int32)])
+    else:
+        build_is_null = jnp.zeros(out_cap, dtype=bool)
+
+    out_cols = tuple(c[probe_idx] for c in pcols) + \
+        tuple(c[build_idx] for c in bcols)
+    out_nulls = tuple(n[probe_idx] for n in pnulls) + \
+        tuple(n[build_idx] | build_is_null for n in bnulls)
+    return out_cols, out_nulls, keep
+
+
+@partial(jax.jit, static_argnames=("probe_cap", "out_cap"))
+def _semi_matched(lo, count, pkey_cols, bkey_cols, probe_cap: int,
+                  out_cap: int):
+    """Per-probe-row matched flag: expand candidates, verify raw keys,
+    segment-OR back onto probe rows (collision-safe for any key mode)."""
+    probe_idx, build_idx, lane_valid = _expand_matches(lo, count, out_cap)
+    keep = lane_valid
+    for pc, bc in zip(pkey_cols, bkey_cols):
+        keep = keep & (pc[probe_idx] == bc[build_idx])
+    matched = jnp.zeros(probe_cap + 1, dtype=bool)
+    matched = matched.at[jnp.where(keep, probe_idx, probe_cap)].max(True)
+    return matched[:-1]
+
+
+def _pad_concat(arrays, cap: int, fill: bool = False):
+    cat = jnp.concatenate(list(arrays))
+    n = cat.shape[0]
+    if n == cap:
+        return cat
+    pad = jnp.full((cap - n,), fill, dtype=cat.dtype) if cat.dtype == bool \
+        else jnp.zeros((cap - n,), dtype=cat.dtype)
+    return jnp.concatenate([cat, pad])

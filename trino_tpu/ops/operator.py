@@ -1,0 +1,215 @@
+"""Operator protocol + simple relational operators.
+
+Reference analog: ``core/trino-main/.../operator/Operator.java:21-93``
+(needsInput/addInput/getOutput/finish/isBlocked) and the simple operators
+(LimitOperator, ValuesOperator, TableScanOperator, ScanFilterAndProject).
+
+Pages flowing between operators are ``DevicePage``s — padded device
+batches with validity masks — so a pipeline's hot ops chain on device
+without host round-trips. Host boundaries are scans (numpy -> device) and
+output (device -> numpy).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..block import DevicePage, Page
+from ..connectors.spi import ColumnHandle, Connector, ConnectorSplit
+from ..expr.compiler import PageProcessor
+
+
+class Operator:
+    """One stage of a pipeline (reference: operator/Operator.java)."""
+
+    def needs_input(self) -> bool:
+        return not self._finishing
+
+    def add_input(self, page: DevicePage):
+        raise NotImplementedError
+
+    def get_output(self) -> Optional[DevicePage]:
+        return None
+
+    def finish(self):
+        self._finishing = True
+
+    def is_finished(self) -> bool:
+        raise NotImplementedError
+
+    _finishing = False
+
+
+class SourceOperator(Operator):
+    """Pipeline head driven by splits (reference: SourceOperator.java)."""
+
+    def add_split(self, split: ConnectorSplit):
+        raise NotImplementedError
+
+    def no_more_splits(self):
+        pass
+
+    def add_input(self, page):
+        raise AssertionError("source operators take splits, not pages")
+
+    def needs_input(self) -> bool:
+        return False
+
+
+class TableScanOperator(SourceOperator):
+    """Pulls pages from connector page sources and uploads them to device
+    (reference: operator/TableScanOperator.java)."""
+
+    def __init__(self, connector: Connector, columns: Sequence[ColumnHandle]):
+        self.connector = connector
+        self.columns = list(columns)
+        self._splits: List[ConnectorSplit] = []
+        self._source = None
+        self._no_more_splits = False
+        self._done = False
+
+    def add_split(self, split: ConnectorSplit):
+        self._splits.append(split)
+
+    def no_more_splits(self):
+        self._no_more_splits = True
+
+    def get_output(self) -> Optional[DevicePage]:
+        while True:
+            if self._source is None:
+                if self._splits:
+                    split = self._splits.pop(0)
+                    self._source = self.connector.page_source(
+                        split, self.columns)
+                elif self._no_more_splits or self._finishing:
+                    self._done = True
+                    return None
+                else:
+                    return None
+            page = self._source.get_next_page()
+            if page is None:
+                if self._source.is_finished():
+                    self._source.close()
+                    self._source = None
+                    continue
+                return None
+            if page.num_rows == 0:
+                continue
+            return DevicePage.from_page(page)
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class FilterProjectOperator(Operator):
+    """Fused filter+project via a compiled PageProcessor (reference:
+    ScanFilterAndProjectOperator / FilterAndProjectOperator +
+    operator/project/PageProcessor.java)."""
+
+    def __init__(self, processor: PageProcessor):
+        self.processor = processor
+        self._pending: Optional[DevicePage] = None
+        self._done = False
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, page: DevicePage):
+        assert self._pending is None
+        self._pending = self.processor.process(page)
+
+    def get_output(self) -> Optional[DevicePage]:
+        out, self._pending = self._pending, None
+        if out is None and self._finishing:
+            self._done = True
+        return out
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class LimitOperator(Operator):
+    """LIMIT n (reference: operator/LimitOperator.java)."""
+
+    def __init__(self, limit: int):
+        self.remaining = limit
+        self._pending: Optional[DevicePage] = None
+        self._done = False
+
+    def needs_input(self) -> bool:
+        return (self._pending is None and self.remaining > 0
+                and not self._finishing)
+
+    def add_input(self, page: DevicePage):
+        if self.remaining <= 0:
+            return
+        count = page.count()
+        if count <= self.remaining:
+            self.remaining -= count
+            self._pending = page
+        else:
+            # keep only the first `remaining` live lanes
+            valid = np.asarray(page.valid)
+            live = np.nonzero(valid)[0]
+            keep = np.zeros_like(valid)
+            keep[live[: self.remaining]] = True
+            import jax.numpy as jnp
+
+            self._pending = DevicePage(page.types, page.cols, page.nulls,
+                                       jnp.asarray(keep), page.dictionaries)
+            self.remaining = 0
+
+    def get_output(self) -> Optional[DevicePage]:
+        out, self._pending = self._pending, None
+        if out is None and (self._finishing or self.remaining <= 0):
+            self._done = True
+        return out
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class ValuesOperator(SourceOperator):
+    """Inline literal rows (reference: operator/ValuesOperator.java)."""
+
+    def __init__(self, pages: Sequence[Page]):
+        self._pages = list(pages)
+        self._done = False
+
+    def add_split(self, split):
+        raise AssertionError("values has no splits")
+
+    def get_output(self) -> Optional[DevicePage]:
+        if self._pages:
+            return DevicePage.from_page(self._pages.pop(0))
+        self._done = True
+        return None
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class OutputCollectorOperator(Operator):
+    """Pipeline sink: densifies device pages back to host Pages
+    (reference analog: TaskOutputOperator feeding the OutputBuffer)."""
+
+    def __init__(self):
+        self.pages: List[Page] = []
+        self._done = False
+
+    def add_input(self, page: DevicePage):
+        host = page.to_page()
+        if host.num_rows:
+            self.pages.append(host)
+
+    def get_output(self):
+        return None
+
+    def finish(self):
+        super().finish()
+        self._done = True
+
+    def is_finished(self) -> bool:
+        return self._done

@@ -1,0 +1,156 @@
+"""Sort + TopN operators.
+
+Reference analog: ``operator/OrderByOperator.java`` (PagesIndex + compiled
+PagesIndexOrdering) and ``operator/TopNOperator.java``.
+
+TPU redesign: ordering keys normalize to (null-bit, u64) operand pairs
+(ops/sortkeys.py) and the whole batch sorts in one ``lax.sort`` carrying
+all payload columns. TopN keeps a running device-resident top-N: each
+incoming page concatenates with the current candidates, sorts, truncates —
+memory stays O(N + page).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..block import DevicePage, padded_size
+from .operator import Operator
+from .sortkeys import SortKey, sort_operands
+
+
+@partial(jax.jit, static_argnames=("num_key_ops",))
+def _sorted_by(key_ops, cols, nulls, valid, num_key_ops: int):
+    """Sort carrying all columns; invalid lanes last."""
+    operands = [(~valid).astype(jnp.uint8)] + list(key_ops) + list(cols) \
+        + list(nulls) + [valid]
+    s = jax.lax.sort(operands, num_keys=1 + num_key_ops, is_stable=True)
+    n = len(cols)
+    base = 1 + num_key_ops
+    return (tuple(s[base:base + n]), tuple(s[base + n:base + 2 * n]),
+            s[-1])
+
+
+def _make_key_ops(page: DevicePage, keys: Sequence[SortKey]):
+    ops = []
+    for k in keys:
+        ops.extend(sort_operands(
+            page.cols[k.channel], page.nulls[k.channel],
+            page.types[k.channel], page.dictionaries[k.channel],
+            ascending=k.ascending,
+            nulls_last=k.nulls_last if k.nulls_last is not None
+            else k.ascending))
+    return tuple(ops)
+
+
+def _concat_pages(pages: List[DevicePage], cap: int) -> DevicePage:
+    types = pages[0].types
+    dicts = [None] * len(types)
+    for p in pages:
+        for i, d in enumerate(p.dictionaries):
+            if d is not None:
+                if dicts[i] is None:
+                    dicts[i] = d
+                elif dicts[i] is not d:
+                    raise T.TrinoError(
+                        "dictionary pools differ across sorted pages",
+                        "GENERIC_INTERNAL_ERROR")
+    cols, nulls = [], []
+    for i in range(len(types)):
+        cols.append(_pad(jnp.concatenate([p.cols[i] for p in pages]), cap))
+        nulls.append(_pad(jnp.concatenate([p.nulls[i] for p in pages]), cap,
+                          fill=True))
+    valid = _pad(jnp.concatenate([p.valid for p in pages]), cap)
+    return DevicePage(types, cols, nulls, valid, dicts)
+
+
+def _pad(arr, cap, fill=False):
+    n = arr.shape[0]
+    if n == cap:
+        return arr
+    if arr.dtype == bool:
+        pad = jnp.full((cap - n,), fill, dtype=bool)
+    else:
+        pad = jnp.zeros((cap - n,), dtype=arr.dtype)
+    return jnp.concatenate([arr, pad])
+
+
+class OrderByOperator(Operator):
+    """Full sort at finish (reference: OrderByOperator.java)."""
+
+    def __init__(self, input_types: Sequence[T.Type],
+                 sort_keys: Sequence[SortKey]):
+        self.input_types = list(input_types)
+        self.sort_keys = list(sort_keys)
+        self._pages: List[DevicePage] = []
+        self._emitted = False
+        self._done = False
+
+    def add_input(self, page: DevicePage):
+        self._pages.append(page)
+
+    def get_output(self) -> Optional[DevicePage]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        self._done = True
+        if not self._pages:
+            return None
+        cap = padded_size(sum(p.capacity for p in self._pages))
+        page = _concat_pages(self._pages, cap)
+        key_ops = _make_key_ops(page, self.sort_keys)
+        cols, nulls, valid = _sorted_by(key_ops, tuple(page.cols),
+                                        tuple(page.nulls), page.valid,
+                                        num_key_ops=len(key_ops))
+        return DevicePage(page.types, list(cols), list(nulls), valid,
+                          page.dictionaries)
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class TopNOperator(Operator):
+    """ORDER BY ... LIMIT n with bounded memory (reference:
+    TopNOperator.java / GroupedTopNBuilder)."""
+
+    def __init__(self, input_types: Sequence[T.Type],
+                 sort_keys: Sequence[SortKey], n: int):
+        self.input_types = list(input_types)
+        self.sort_keys = list(sort_keys)
+        self.n = n
+        self._top: Optional[DevicePage] = None
+        self._emitted = False
+        self._done = False
+
+    def add_input(self, page: DevicePage):
+        pages = [self._top, page] if self._top is not None else [page]
+        cap = padded_size(sum(p.capacity for p in pages))
+        merged = _concat_pages(pages, cap)
+        key_ops = _make_key_ops(merged, self.sort_keys)
+        cols, nulls, valid = _sorted_by(key_ops, tuple(merged.cols),
+                                        tuple(merged.nulls), merged.valid,
+                                        num_key_ops=len(key_ops))
+        keep = padded_size(max(self.n, 16))
+        if keep < cap:
+            cols = tuple(c[:keep] for c in cols)
+            nulls = tuple(x[:keep] for x in nulls)
+            valid = valid[:keep]
+        valid = valid & (jnp.arange(valid.shape[0]) < self.n)
+        self._top = DevicePage(merged.types, list(cols), list(nulls), valid,
+                               merged.dictionaries)
+
+    def get_output(self) -> Optional[DevicePage]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        self._done = True
+        return self._top
+
+    def is_finished(self) -> bool:
+        return self._done

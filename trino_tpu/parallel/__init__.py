@@ -1,0 +1,1 @@
+from .exchange import hash_partition_ids, repartition_a2a  # noqa: F401

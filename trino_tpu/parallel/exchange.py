@@ -1,0 +1,96 @@
+"""Device-collective exchange: hash repartitioning as XLA all-to-all.
+
+Reference analog: the ENTIRE pull-based HTTP shuffle path —
+``operator/output/PartitionedOutputOperator.java`` + ``PagePartitioner``
+(producer side) and ``operator/ExchangeOperator.java`` +
+``DirectExchangeClient`` (consumer side), SURVEY.md §2.8.
+
+TPU-first redesign: when producer and consumer stages are co-resident on a
+pod slice, a stage boundary needs no serialization, no HTTP, no buffers —
+each device bucket-sorts its rows by destination partition and one XLA
+``all_to_all`` over ICI delivers every row to its owner. The host never
+touches the data.
+
+Capacity model: all_to_all needs equal-sized lanes, so each device sends a
+fixed ``per_dest`` lanes to each destination. Rows beyond capacity are
+counted in the returned ``overflow`` (host checks and can re-run with a
+larger factor); with hash partitioning overflow implies heavy skew.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def hash_partition_ids(keys_u64: Sequence, num_partitions: int):
+    """Combine pre-normalized uint64 key columns into partition ids.
+
+    Mirrors the reference's InterpretedHashGenerator (CRC-style combined
+    row hash -> partition), using splitmix64 finalization per column.
+    """
+    acc = jnp.zeros(keys_u64[0].shape, dtype=jnp.uint64)
+    for k in keys_u64:
+        z = (k + np.uint64(0x9E3779B97F4A7C15)) * np.uint64(0xBF58476D1CE4E5B9)
+        z = z ^ (z >> np.uint64(27))
+        acc = acc * np.uint64(31) + z
+    acc = acc ^ (acc >> np.uint64(33))
+    return (acc % np.uint64(num_partitions)).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("num_partitions", "per_dest", "axis_name"))
+def repartition_a2a(cols: Tuple, nulls: Tuple, valid, part_ids,
+                    num_partitions: int, per_dest: int,
+                    axis_name: str = "x"):
+    """Inside shard_map: route each live row to the device owning its
+    partition. Returns (cols, nulls, valid, overflow_count) with capacity
+    num_partitions * per_dest on each receiver.
+
+    Implementation: bucket-sort rows by destination, lay them into a
+    (num_partitions, per_dest) send grid, one lax.all_to_all, flatten.
+    """
+    cap = valid.shape[0]
+    # sort rows by (invalid, destination): live rows grouped by dest
+    dest = jnp.where(valid, part_ids, num_partitions)
+    operands = [dest.astype(jnp.int32)] + list(cols) + list(nulls) + [valid]
+    s = jax.lax.sort(operands, num_keys=1, is_stable=False)
+    s_dest, s_rest = s[0], s[1:]
+    ncols = len(cols)
+    s_cols, s_nulls, s_valid = (s_rest[:ncols], s_rest[ncols:2 * ncols],
+                                s_rest[-1])
+
+    # position of each row within its destination bucket
+    start = jnp.searchsorted(s_dest, jnp.arange(num_partitions,
+                                                dtype=jnp.int32))
+    pos = jnp.arange(cap, dtype=jnp.int32) - start[jnp.clip(
+        s_dest, 0, num_partitions - 1)]
+    in_grid = s_valid & (pos < per_dest)
+    overflow = jnp.sum(s_valid & ~in_grid)
+
+    # scatter into the (num_partitions * per_dest) send grid
+    slot = jnp.where(in_grid,
+                     jnp.clip(s_dest, 0, num_partitions - 1) * per_dest + pos,
+                     num_partitions * per_dest)  # dropped lanes -> overflow slot
+
+    def to_grid(col):
+        grid = jnp.zeros((num_partitions * per_dest + 1,), dtype=col.dtype)
+        grid = grid.at[slot].set(col, mode="drop")
+        return grid[:-1].reshape(num_partitions, per_dest)
+
+    g_cols = [to_grid(c) for c in s_cols]
+    g_nulls = [to_grid(n) for n in s_nulls]
+    g_valid = to_grid(in_grid)
+
+    # the collective: row i of my grid goes to device i
+    def a2a(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                                  tiled=True)
+
+    r_cols = tuple(a2a(c).reshape(-1) for c in g_cols)
+    r_nulls = tuple(a2a(n).reshape(-1) for n in g_nulls)
+    r_valid = a2a(g_valid).reshape(-1)
+    return r_cols, r_nulls, r_valid, overflow
