@@ -1,0 +1,253 @@
+"""End-to-end SQL tests through LocalQueryRunner (reference analog:
+AbstractTestQueries over TpchQueryRunner)."""
+
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=4096)},
+                            Session(catalog="tpch", schema="micro"))
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+def test_select_literal(runner):
+    assert q(runner, "select 1") == [(1,)]
+    assert q(runner, "select 1 + 2 * 3, 'x'") == [(7, "x")]
+
+
+def test_values(runner):
+    rows = q(runner, "select * from (values (1, 'a'), (2, 'b')) t(x, y) "
+                     "order by x")
+    assert rows == [(1, "a"), (2, "b")]
+
+
+def test_scan_count(runner):
+    n = q(runner, "select count(*) from nation")[0][0]
+    assert n == 25
+
+
+def test_filter_project(runner):
+    rows = q(runner, "select n_name, n_regionkey from nation "
+                     "where n_regionkey = 0 order by n_name")
+    assert all(r[1] == 0 for r in rows)
+    assert len(rows) == 5
+
+
+def test_global_aggregation(runner):
+    rows = q(runner, "select count(*), min(n_nationkey), max(n_nationkey) "
+                     "from nation")
+    assert rows == [(25, 0, 24)]
+
+
+def test_group_by_having(runner):
+    rows = q(runner, "select n_regionkey, count(*) c from nation "
+                     "group by n_regionkey having count(*) >= 5 "
+                     "order by n_regionkey")
+    assert rows == [(0, 5), (1, 5), (2, 5), (3, 5), (4, 5)]
+
+
+def test_join_explicit(runner):
+    rows = q(runner, """
+        select n.n_name, r.r_name from nation n
+        join region r on n.n_regionkey = r.r_regionkey
+        where r.r_name = 'ASIA' order by n.n_name""")
+    assert len(rows) == 5
+    assert all(r[1] == "ASIA" for r in rows)
+
+
+def test_join_implicit(runner):
+    rows = q(runner, """
+        select count(*) from nation, region
+        where n_regionkey = r_regionkey""")
+    assert rows == [(25,)]
+
+
+def test_left_join(runner):
+    rows = q(runner, """
+        select r_name, c from region left join (
+            select n_regionkey, count(*) c from nation
+            where n_nationkey < 3 group by n_regionkey) x
+        on r_regionkey = n_regionkey
+        order by r_name""")
+    assert len(rows) == 5
+    # nations 0,1,2 are in regions 0,1,1
+    by_name = dict(rows)
+    assert sum(1 for v in by_name.values() if v is None) == 3
+
+
+def test_order_limit_offset(runner):
+    rows = q(runner, "select n_nationkey from nation "
+                     "order by n_nationkey limit 3")
+    assert rows == [(0,), (1,), (2,)]
+    rows = q(runner, "select n_nationkey from nation "
+                     "order by n_nationkey desc limit 2")
+    assert rows == [(24,), (23,)]
+
+
+def test_distinct(runner):
+    rows = q(runner, "select distinct n_regionkey from nation "
+                     "order by n_regionkey")
+    assert rows == [(0,), (1,), (2,), (3,), (4,)]
+
+
+def test_union(runner):
+    rows = q(runner, "select 1 x union all select 2 union all select 1 "
+                     "order by x")
+    assert rows == [(1,), (1,), (2,)]
+    rows = q(runner, "select 1 x union select 1 union select 2 order by x")
+    assert rows == [(1,), (2,)]
+
+
+def test_in_list(runner):
+    rows = q(runner, "select count(*) from nation "
+                     "where n_regionkey in (0, 2)")
+    assert rows == [(10,)]
+
+
+def test_in_subquery(runner):
+    rows = q(runner, """
+        select count(*) from nation where n_regionkey in
+        (select r_regionkey from region where r_name like 'A%')""")
+    # ASIA, AMERICA, AFRICA -> 15 nations
+    assert rows == [(15,)]
+
+
+def test_not_in_subquery(runner):
+    rows = q(runner, """
+        select count(*) from nation where n_regionkey not in
+        (select r_regionkey from region where r_name like 'A%')""")
+    assert rows == [(10,)]
+
+
+def test_exists_correlated(runner):
+    rows = q(runner, """
+        select r_name from region r where exists (
+            select 1 from nation n where n.n_regionkey = r.r_regionkey
+            and n.n_nationkey < 2)
+        order by r_name""")
+    # nations 0,1 live in regions 0,1
+    assert len(rows) == 2
+
+
+def test_scalar_subquery_uncorrelated(runner):
+    rows = q(runner, """
+        select count(*) from nation
+        where n_nationkey > (select avg(n_nationkey) from nation)""")
+    assert rows == [(12,)]
+
+
+def test_scalar_subquery_correlated_agg(runner):
+    rows = q(runner, """
+        select count(*) from nation n1
+        where n_nationkey = (
+            select max(n_nationkey) from nation n2
+            where n2.n_regionkey = n1.n_regionkey)""")
+    assert rows == [(5,)]
+
+
+def test_case_expression(runner):
+    rows = q(runner, """
+        select sum(case when n_regionkey = 0 then 1 else 0 end)
+        from nation""")
+    assert rows == [(5,)]
+
+
+def test_arithmetic_on_aggregates(runner):
+    rows = q(runner, """
+        select count(*) * 2 + 1 from nation""")
+    assert rows == [(51,)]
+
+
+def test_cte(runner):
+    rows = q(runner, """
+        with asia as (select * from region where r_name = 'ASIA')
+        select n_name from nation, asia
+        where n_regionkey = r_regionkey order by n_name limit 1""")
+    assert len(rows) == 1
+
+
+def test_show_and_explain(runner):
+    catalogs = runner.execute("show catalogs").rows
+    assert ("tpch",) in catalogs
+    plan = runner.explain("select count(*) from nation")
+    assert "Aggregation" in plan and "TableScan" in plan
+
+
+# -- regressions from code review ------------------------------------------
+
+
+def test_subquery_in_select_list(runner):
+    rows = q(runner, """
+        select r_name, (select count(*) from nation n
+                        where n.n_regionkey = r.r_regionkey) c
+        from region r order by r_name""")
+    assert len(rows) == 5
+    assert all(r[1] == 5 for r in rows)
+
+
+def test_correlated_count_empty_group_is_zero(runner):
+    rows = q(runner, """
+        select count(*) from region r where (
+            select count(*) from nation n
+            where n.n_regionkey = r.r_regionkey and n.n_nationkey < 0) = 0""")
+    assert rows == [(5,)]
+
+
+def test_union_distinct_strings(runner):
+    rows = q(runner, "select 'a' x union select 'b' union select 'a' "
+                     "order by x")
+    assert rows == [("a",), ("b",)]
+
+
+def test_union_strings_from_tables(runner):
+    rows = q(runner, "select n_name v from nation where n_nationkey = 0 "
+                     "union all select r_name from region "
+                     "where r_regionkey = 0 order by v")
+    assert rows == [("AFRICA",), ("ALGERIA",)]
+
+
+def test_not_in_with_null_in_subquery(runner):
+    rows = q(runner, """
+        select count(*) from nation where n_regionkey not in
+        (select case when r_regionkey = 0 then null else r_regionkey end
+         from region)""")
+    assert rows == [(0,)]
+
+
+def test_not_in_empty_subquery(runner):
+    rows = q(runner, """
+        select count(*) from nation where n_regionkey not in
+        (select r_regionkey from region where r_name = 'NOPE')""")
+    assert rows == [(25,)]
+
+
+def test_all_over_empty_set_is_true(runner):
+    rows = q(runner, """
+        select count(*) from nation where n_nationkey > all
+        (select n_nationkey from nation where n_nationkey < 0)""")
+    assert rows == [(25,)]
+
+
+def test_any_quantified(runner):
+    rows = q(runner, """
+        select count(*) from nation where n_nationkey > any
+        (select r_regionkey from region)""")
+    # > min(0) -> nationkey >= 1 -> 24 rows
+    assert rows == [(24,)]
+
+
+def test_group_by_select_alias_expression(runner):
+    rows = q(runner, """
+        select n_regionkey + 1 as a, count(*) from nation
+        group by a order by a""")
+    assert rows == [(1, 5), (2, 5), (3, 5), (4, 5), (5, 5)]

@@ -1,0 +1,370 @@
+"""Local execution planner: PlanNode tree -> operator pipelines.
+
+Reference analog: ``sql/planner/LocalExecutionPlanner.java`` (4,405 LoC):
+the visitor that turns a plan fragment into DriverFactories, fixing the
+physical channel layout of every pipeline and compiling expressions. Here
+a plan compiles to an ordered list of Drivers (join build sides and union
+inputs run before their consumers — the reference sequences these through
+pipeline dependencies and JoinBridges, same idea).
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..block import Page
+from ..expr.compiler import PageProcessor
+from ..expr.ir import Call, InputRef, Literal, RowExpression
+from ..ops.aggregation import AggCall, HashAggregationOperator
+from ..ops.join import HashBuilderOperator, JoinBridge, LookupJoinOperator
+from ..ops.operator import (DeferredPagesSourceOperator,
+                            EnforceSingleRowOperator, FilterProjectOperator,
+                            LimitOperator, OffsetOperator, Operator,
+                            OutputCollectorOperator, TableScanOperator,
+                            ValuesOperator)
+from ..ops.sort import OrderByOperator, TopNOperator
+from ..ops.sortkeys import SortKey
+from ..planner.logical_planner import Metadata
+from ..planner.plan import (AggregationNode, CrossJoinNode, DistinctNode,
+                            EnforceSingleRowNode, ExceptNode, FilterNode,
+                            IntersectNode, JoinNode, LimitNode, OutputNode,
+                            PlanNode, ProjectNode, SortNode, TableScanNode,
+                            TopNNode, UnionNode, ValuesNode)
+from ..planner.symbols import Symbol, to_input_refs
+from ..types import TrinoError
+
+
+class PhysicalPipeline:
+    """One operator chain; drivers run pipelines in list order (upstream
+    build/union pipelines first)."""
+
+    def __init__(self, operators: List[Operator]):
+        self.operators = operators
+
+
+class LocalExecutionPlan:
+    def __init__(self, pipelines: List[PhysicalPipeline],
+                 sink: OutputCollectorOperator,
+                 column_names: List[str], output_types: List[T.Type]):
+        self.pipelines = pipelines
+        self.sink = sink
+        self.column_names = column_names
+        self.output_types = output_types
+
+    def execute(self) -> List[Page]:
+        from .driver import Driver
+
+        for p in self.pipelines:
+            Driver(p.operators).run_to_completion()
+        return self.sink.pages
+
+
+class LocalExecutionPlanner:
+    def __init__(self, metadata: Metadata, desired_splits: int = 4):
+        self.metadata = metadata
+        self.desired_splits = desired_splits
+        self.pipelines: List[PhysicalPipeline] = []
+
+    def plan(self, root: OutputNode) -> LocalExecutionPlan:
+        ops, layout, types_ = self.visit(root.source)
+        # final projection into output order
+        projections = [InputRef(s.type, layout[s.name])
+                       for s in root.outputs]
+        if [p.channel for p in projections] != list(range(len(types_))) or \
+                len(projections) != len(types_):
+            ops.append(FilterProjectOperator(
+                PageProcessor(types_, projections)))
+        sink = OutputCollectorOperator()
+        ops.append(sink)
+        self.pipelines.append(PhysicalPipeline(ops))
+        return LocalExecutionPlan(
+            self.pipelines, sink, root.column_names,
+            [s.type for s in root.outputs])
+
+    # ------------------------------------------------------------------
+
+    def visit(self, node: PlanNode
+              ) -> Tuple[List[Operator], Dict[str, int], List[T.Type]]:
+        m = getattr(self, "_v_" + type(node).__name__, None)
+        if m is None:
+            raise TrinoError(
+                f"no local planning for {type(node).__name__}",
+                "NOT_SUPPORTED")
+        return m(node)
+
+    def _v_TableScanNode(self, node: TableScanNode):
+        conn = self.metadata.connectors[node.catalog]
+        columns = [c for _, c in node.assignments]
+        scan = TableScanOperator(conn, columns)
+        for split in conn.split_manager().get_splits(node.table,
+                                                     self.desired_splits):
+            scan.add_split(split)
+        scan.no_more_splits()
+        layout = {s.name: i for i, (s, _) in enumerate(node.assignments)}
+        types_ = [s.type for s, _ in node.assignments]
+        return [scan], layout, types_
+
+    def _v_ValuesNode(self, node: ValuesNode):
+        types_ = [s.type for s in node.symbols]
+        columns: List[List] = [[] for _ in node.symbols]
+        for row in node.rows:
+            for i, e in enumerate(row):
+                columns[i].append(_eval_literal(e))
+        if not node.symbols:
+            # single empty row (SELECT without FROM)
+            page = Page.from_pylists([], [])
+            page.num_rows = max(1, len(node.rows))
+            pages = [page]
+        else:
+            pages = [Page.from_pylists(types_, columns)]
+        layout = {s.name: i for i, s in enumerate(node.symbols)}
+        return [ValuesOperator(pages)], layout, types_
+
+    def _v_FilterNode(self, node: FilterNode):
+        ops, layout, types_ = self.visit(node.source)
+        pred = to_input_refs(node.predicate, layout)
+        projections = [InputRef(t, i) for i, t in enumerate(types_)]
+        ops.append(FilterProjectOperator(
+            PageProcessor(types_, projections, pred)))
+        return ops, layout, types_
+
+    def _v_ProjectNode(self, node: ProjectNode):
+        ops, layout, types_ = self.visit(node.source)
+        projections = [to_input_refs(e, layout) for _, e in node.assignments]
+        ops.append(FilterProjectOperator(PageProcessor(types_, projections)))
+        new_layout = {s.name: i for i, (s, _) in enumerate(node.assignments)}
+        return ops, new_layout, [s.type for s, _ in node.assignments]
+
+    def _v_JoinNode(self, node: JoinNode):
+        return self._plan_join(node.join_type, node.left, node.right,
+                               node.criteria, node.filter_expr)
+
+    def _v_CrossJoinNode(self, node: CrossJoinNode):
+        # const-key equi join (build side replicated once)
+        return self._plan_join("inner", node.left, node.right, [],
+                               None)
+
+    def _plan_join(self, join_type: str, left: PlanNode, right: PlanNode,
+                   criteria: List[Tuple[Symbol, Symbol]],
+                   filter_expr: Optional[RowExpression]):
+        bops, blayout, btypes = self.visit(right)
+        pops, playout, ptypes = self.visit(left)
+
+        const_key = not criteria
+        if const_key:
+            # append literal-0 key channel to both sides
+            bops.append(FilterProjectOperator(PageProcessor(
+                btypes, [InputRef(t, i) for i, t in enumerate(btypes)]
+                + [Literal(T.BIGINT, 0)])))
+            btypes = btypes + [T.BIGINT]
+            pops.append(FilterProjectOperator(PageProcessor(
+                ptypes, [InputRef(t, i) for i, t in enumerate(ptypes)]
+                + [Literal(T.BIGINT, 0)])))
+            ptypes = ptypes + [T.BIGINT]
+            build_keys = [len(btypes) - 1]
+            probe_keys = [len(ptypes) - 1]
+        else:
+            build_keys = []
+            probe_keys = []
+            for lsym, rsym in criteria:
+                if lsym.type.is_string or rsym.type.is_string:
+                    raise TrinoError(
+                        "string equi-join keys not supported yet "
+                        "(dictionary unification pending)",
+                        "NOT_SUPPORTED")
+                probe_keys.append(playout[lsym.name])
+                build_keys.append(blayout[rsym.name])
+
+        bridge = JoinBridge()
+        bops.append(HashBuilderOperator(btypes, build_keys, bridge))
+        self.pipelines.append(PhysicalPipeline(bops))
+
+        filter_fn = None
+        if filter_expr is not None:
+            if join_type in ("semi", "anti"):
+                raise TrinoError(
+                    "filtered semi/anti join not supported yet",
+                    "NOT_SUPPORTED")
+            combined_layout = dict(playout)
+            for name, ch in blayout.items():
+                combined_layout[name] = len(ptypes) + ch
+            combined_types = ptypes + btypes
+            pred = to_input_refs(filter_expr, combined_layout)
+            proc = PageProcessor(
+                combined_types,
+                [InputRef(t, i) for i, t in enumerate(combined_types)],
+                pred)
+            filter_fn = proc.process
+
+        pops.append(LookupJoinOperator(ptypes, probe_keys, bridge,
+                                       join_type, filter_fn))
+        if join_type in ("semi", "anti"):
+            out_layout = dict(playout)
+            out_types = ptypes
+        else:
+            out_layout = dict(playout)
+            for name, ch in blayout.items():
+                out_layout[name] = len(ptypes) + ch
+            out_types = ptypes + btypes
+        return pops, out_layout, out_types
+
+    def _v_AggregationNode(self, node: AggregationNode):
+        ops, layout, types_ = self.visit(node.source)
+        group_channels = [layout[s.name] for s in node.group_keys]
+        aggs = []
+        for out_sym, a in node.aggregations:
+            if a.distinct:
+                raise TrinoError(
+                    "DISTINCT aggregates execute via the planner rewrite; "
+                    "this one was not rewritten", "NOT_SUPPORTED")
+            if a.argument is None:
+                aggs.append(AggCall("count_star", None, None, out_sym.type))
+            else:
+                ch = layout[a.argument.name]
+                aggs.append(AggCall(a.function, ch, types_[ch],
+                                    out_sym.type))
+        op = HashAggregationOperator(types_, group_channels, aggs,
+                                     step=node.step)
+        ops.append(op)
+        new_layout = {}
+        out_types = []
+        for i, s in enumerate(node.group_keys):
+            new_layout[s.name] = i
+            out_types.append(types_[group_channels[i]])
+        base = len(node.group_keys)
+        for j, (out_sym, _a) in enumerate(node.aggregations):
+            new_layout[out_sym.name] = base + j
+            out_types.append(out_sym.type)
+        return ops, new_layout, out_types
+
+    def _v_DistinctNode(self, node: DistinctNode):
+        ops, layout, types_ = self.visit(node.source)
+        order = sorted(layout.items(), key=lambda kv: kv[1])
+        op = HashAggregationOperator(types_, [ch for _, ch in order], [])
+        ops.append(op)
+        new_layout = {name: i for i, (name, _) in enumerate(order)}
+        return ops, new_layout, types_
+
+    def _v_SortNode(self, node: SortNode):
+        ops, layout, types_ = self.visit(node.source)
+        keys = _sort_keys(node.orderings, layout)
+        ops.append(OrderByOperator(types_, keys))
+        return ops, layout, types_
+
+    def _v_TopNNode(self, node: TopNNode):
+        ops, layout, types_ = self.visit(node.source)
+        keys = _sort_keys(node.orderings, layout)
+        ops.append(TopNOperator(types_, keys, node.count))
+        return ops, layout, types_
+
+    def _v_LimitNode(self, node: LimitNode):
+        ops, layout, types_ = self.visit(node.source)
+        if node.offset:
+            ops.append(OffsetOperator(node.offset))
+        if node.count is not None:
+            ops.append(LimitOperator(node.count))
+        return ops, layout, types_
+
+    def _v_EnforceSingleRowNode(self, node: EnforceSingleRowNode):
+        ops, layout, types_ = self.visit(node.source)
+        ops.append(EnforceSingleRowOperator(types_))
+        return ops, layout, types_
+
+    def _v_UnionNode(self, node: UnionNode):
+        collectors = []
+        for child in node.inputs:
+            cops, clayout, ctypes = self.visit(child)
+            # project to union symbol order
+            projections = [InputRef(s.type, clayout[cs.name])
+                           for s, cs in zip(node.symbols,
+                                            child.output_symbols)]
+            cops.append(FilterProjectOperator(
+                PageProcessor(ctypes, projections)))
+            sink = OutputCollectorOperator()
+            cops.append(sink)
+            self.pipelines.append(PhysicalPipeline(cops))
+            collectors.append(sink)
+        types_ = [s.type for s in node.symbols]
+
+        def union_pages(cs=collectors, types_=types_):
+            pages = [p for c in cs for p in c.pages]
+            if not pages:
+                return []
+            if any(t.is_string for t in types_):
+                # unify dictionary pools across children (Page.concat
+                # re-encodes into the first pool)
+                return [Page.concat(pages)]
+            return pages
+
+        source = DeferredPagesSourceOperator(union_pages)
+        layout = {s.name: i for i, s in enumerate(node.symbols)}
+        return [source], layout, [s.type for s in node.symbols]
+
+    def _v_IntersectNode(self, node: IntersectNode):
+        return self._set_semantics_join(node, "semi")
+
+    def _v_ExceptNode(self, node: ExceptNode):
+        return self._set_semantics_join(node, "anti")
+
+    def _set_semantics_join(self, node, join_type: str):
+        """INTERSECT/EXCEPT = Distinct(left) semi/anti-join right on all
+        columns. NOTE: SQL set ops treat NULLs as equal; the join treats
+        NULL keys as non-matching — NULL-row edge cases differ until the
+        join gains IS NOT DISTINCT semantics."""
+        left, right = node.inputs
+        ltypes = [s.type for s in node.symbols]
+        if any(t.is_string for t in ltypes):
+            raise TrinoError(
+                f"{join_type} set operation over varchar columns not "
+                "supported yet", "NOT_SUPPORTED")
+        bops, blayout, btypes = self.visit(right)
+        pops, playout, ptypes = self.visit(left)
+        # align probe/build channel order to symbol order
+        bchans = [blayout[s.name] for s in right.output_symbols]
+        bridge = JoinBridge()
+        bops.append(HashBuilderOperator(btypes, bchans, bridge))
+        self.pipelines.append(PhysicalPipeline(bops))
+        pchans = [playout[s.name] for s in left.output_symbols]
+        pops.append(LookupJoinOperator(ptypes, pchans, bridge, join_type))
+        # distinct over the probe columns; output channels follow pchans
+        # order, i.e. channel j <-> left.output_symbols[j] <-> symbols[j]
+        pops.append(HashAggregationOperator(ptypes, pchans, []))
+        layout = {s.name: j for j, s in enumerate(node.symbols)}
+        out_types = [ptypes[ch] for ch in pchans]
+        return pops, layout, out_types
+
+
+def _sort_keys(orderings, layout) -> List[SortKey]:
+    keys = []
+    for o in orderings:
+        nulls_last = o.nulls_last if o.nulls_last is not None \
+            else o.ascending
+        keys.append(SortKey(layout[o.symbol.name], o.ascending, nulls_last))
+    return keys
+
+
+def _eval_literal(e: RowExpression):
+    """Host evaluation of literal-only expression trees (VALUES rows)."""
+    if isinstance(e, Literal):
+        return e.value
+    if isinstance(e, Call) and e.name == "$cast":
+        v = _eval_literal(e.args[0])
+        if v is None:
+            return None
+        t = e.type
+        if t.is_decimal:
+            return Decimal(str(v))
+        if t in (T.DOUBLE, T.REAL):
+            return float(v)
+        if t in (T.TINYINT, T.SMALLINT, T.INTEGER, T.BIGINT):
+            return int(v)
+        if t.is_string:
+            return str(v)
+        return v
+    if isinstance(e, Call) and e.name == "negate":
+        v = _eval_literal(e.args[0])
+        return None if v is None else -v
+    raise TrinoError(f"VALUES rows must be literals, got {e!r}",
+                     "NOT_SUPPORTED")

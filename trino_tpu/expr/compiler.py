@@ -205,8 +205,9 @@ class PageProcessor:
                              else np.bool_)
                 return lambda env: (jnp.asarray(z), jnp.asarray(True))
             if _is_string(t):
-                raise TypeError_(
-                    "bare string literal outside string operation")
+                # projected string literal: code 0 into the one-entry
+                # dictionary process() resolves via _str_view
+                return lambda env: (jnp.zeros((), dtype=jnp.int32), None)
             raw = self._literal_raw(e)
             return lambda env: (jnp.asarray(raw), None)
 

@@ -191,6 +191,115 @@ class ValuesOperator(SourceOperator):
         return self._done
 
 
+class OffsetOperator(Operator):
+    """OFFSET n: drops the first n live rows (reference:
+    operator/OffsetOperator.java)."""
+
+    def __init__(self, offset: int):
+        self.to_skip = offset
+        self._pending: Optional[DevicePage] = None
+        self._done = False
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def add_input(self, page: DevicePage):
+        if self.to_skip <= 0:
+            self._pending = page
+            return
+        valid = np.asarray(page.valid)
+        live = np.nonzero(valid)[0]
+        if len(live) <= self.to_skip:
+            self.to_skip -= len(live)
+            return
+        keep = np.zeros_like(valid)
+        keep[live[self.to_skip:]] = True
+        self.to_skip = 0
+        import jax.numpy as jnp
+
+        self._pending = DevicePage(page.types, page.cols, page.nulls,
+                                   jnp.asarray(keep), page.dictionaries)
+
+    def get_output(self) -> Optional[DevicePage]:
+        out, self._pending = self._pending, None
+        if out is None and self._finishing:
+            self._done = True
+        return out
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class EnforceSingleRowOperator(Operator):
+    """Scalar-subquery guard: exactly one output row — errors on more,
+    emits an all-NULL row on zero (reference:
+    operator/EnforceSingleRowOperator.java)."""
+
+    def __init__(self, types):
+        self.types = list(types)
+        self._rows = 0
+        self._pages: List[DevicePage] = []
+        self._emitted = False
+        self._done = False
+
+    def add_input(self, page: DevicePage):
+        n = page.count()
+        if not n:
+            return
+        self._rows += n
+        if self._rows > 1:  # fail fast, don't buffer the stream
+            from ..types import TrinoError
+
+            raise TrinoError("Scalar sub-query has returned multiple rows",
+                             "SUBQUERY_MULTIPLE_ROWS")
+        self._pages.append(page)
+
+    def get_output(self) -> Optional[DevicePage]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        self._done = True
+        if self._rows == 1:
+            return self._pages[0]
+        # one all-NULL row
+        row = Page.from_pylists(self.types,
+                                [[None]] * len(self.types) or [])
+        if not self.types:
+            return None
+        return DevicePage.from_page(row)
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class DeferredPagesSourceOperator(SourceOperator):
+    """Source over host pages produced by earlier pipelines of the same
+    task (union inputs, materialized intermediates). The thunk is called
+    at first poll — after upstream pipelines completed."""
+
+    def __init__(self, pages_thunk):
+        self._thunk = pages_thunk
+        self._pages = None
+        self._done = False
+
+    def add_split(self, split):
+        raise AssertionError("deferred source has no splits")
+
+    def get_output(self) -> Optional[DevicePage]:
+        if self._pages is None:
+            self._pages = list(self._thunk())
+        if self._pages:
+            page = self._pages.pop(0)
+            if page.num_rows == 0:
+                return self.get_output()
+            return DevicePage.from_page(page)
+        self._done = True
+        return None
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
 class OutputCollectorOperator(Operator):
     """Pipeline sink: densifies device pages back to host Pages
     (reference analog: TaskOutputOperator feeding the OutputBuffer)."""

@@ -1,0 +1,1 @@
+from .symbols import Symbol, SymbolAllocator, SymbolRef  # noqa: F401

@@ -1,0 +1,417 @@
+"""Plan optimizer: the load-bearing passes.
+
+Reference analog: ``sql/planner/PlanOptimizers.java`` assembles ~90 passes
+(221 iterative rules); the ones that move TPC-H/TPC-DS are realized here
+directly as recursive rewrites:
+- predicate pushdown (``optimizations/PredicatePushDown.java``)
+- implicit-join elimination + greedy join ordering by connector stats
+  (``iterative/rule/ReorderJoins.java`` — full cost-based DP there,
+  size-greedy here; build side = smaller estimated input, matching the
+  reference's broadcast/partitioned build-side choice)
+- column pruning (``iterative/rule/PruneUnreferencedOutputs`` family)
+- identity-projection removal (``RemoveRedundantIdentityProjections``)
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .. import types as T
+from ..expr.ir import Call, Literal, RowExpression
+from .logical_planner import (Metadata, combine_conjuncts, conjuncts)
+from .plan import (AggregationNode, CrossJoinNode, DistinctNode,
+                   EnforceSingleRowNode, ExceptNode, FilterNode,
+                   IntersectNode, JoinNode, LimitNode, OutputNode, PlanNode,
+                   ProjectNode, SortNode, TableScanNode, TopNNode, UnionNode,
+                   ValuesNode)
+from .symbols import (Symbol, SymbolAllocator, SymbolRef, referenced_symbols,
+                      rewrite_symbols)
+
+
+DEFAULT_ROWS = 1_000_000.0
+FILTER_SELECTIVITY = 0.33
+
+
+def optimize(root: OutputNode, metadata: Metadata,
+             allocator: SymbolAllocator) -> OutputNode:
+    opt = Optimizer(metadata, allocator)
+    node = opt.push_filters(root.source, [])
+    node = opt.prune(node, {s.name for s in root.outputs})
+    node = opt.cleanup(node)
+    return OutputNode(node, root.column_names, root.outputs)
+
+
+class Optimizer:
+    def __init__(self, metadata: Metadata, allocator: SymbolAllocator):
+        self.metadata = metadata
+        self.allocator = allocator
+
+    # ------------------------------------------------------------------
+    # predicate pushdown + join building
+
+    def push_filters(self, node: PlanNode,
+                     preds: List[RowExpression]) -> PlanNode:
+        """Push ``preds`` (conjuncts from above) as far down as possible;
+        returns rewritten subtree with unplaced conjuncts applied on top."""
+        if isinstance(node, FilterNode):
+            return self.push_filters(node.source,
+                                     preds + conjuncts(node.predicate))
+
+        if isinstance(node, (CrossJoinNode, JoinNode)) and (
+                isinstance(node, CrossJoinNode) or
+                node.join_type == "inner"):
+            return self._build_join_region(node, preds)
+
+        if isinstance(node, JoinNode):
+            # left/semi/anti: push left-only conjuncts into the probe side
+            left_syms = {s.name for s in node.left.output_symbols}
+            push_left, stay = [], []
+            for p in preds:
+                (push_left if referenced_symbols(p) <= left_syms
+                 else stay).append(p)
+            left = self.push_filters(node.left, push_left)
+            right = self.push_filters(node.right, [])
+            out = JoinNode(node.join_type, left, right, node.criteria,
+                           node.filter_expr)
+            return _apply(out, stay)
+
+        if isinstance(node, ProjectNode):
+            # inline assignments into the conjuncts and push them all —
+            # every scalar here is deterministic, so duplication is safe
+            mapping = {s.name: e for s, e in node.assignments}
+            pushable = [rewrite_symbols(p, mapping) for p in preds]
+            src = self.push_filters(node.source, pushable)
+            return ProjectNode(src, node.assignments)
+
+        if isinstance(node, AggregationNode):
+            keys = {s.name for s in node.group_keys}
+            push, stay = [], []
+            for p in preds:
+                (push if referenced_symbols(p) <= keys else stay).append(p)
+            src = self.push_filters(node.source, push)
+            out = AggregationNode(src, node.group_keys, node.aggregations,
+                                  node.step)
+            return _apply(out, stay)
+
+        if isinstance(node, (SortNode, DistinctNode, EnforceSingleRowNode)):
+            src = self.push_filters(node.sources[0], preds)
+            clone = _replace_source(node, src)
+            return clone
+
+        if isinstance(node, (TopNNode, LimitNode, UnionNode, IntersectNode,
+                             ExceptNode, ValuesNode, TableScanNode)):
+            new_sources = [self.push_filters(s, []) for s in node.sources]
+            clone = _replace_sources(node, new_sources)
+            return _apply(clone, preds)
+
+        if isinstance(node, OutputNode):
+            src = self.push_filters(node.source, preds)
+            return OutputNode(src, node.column_names, node.outputs)
+
+        # default: optimize children, keep conjuncts here
+        new_sources = [self.push_filters(s, []) for s in node.sources]
+        clone = _replace_sources(node, new_sources)
+        return _apply(clone, preds)
+
+    # -- join region ----------------------------------------------------
+
+    def _build_join_region(self, node: PlanNode,
+                           preds: List[RowExpression]) -> PlanNode:
+        """Flatten nested inner/cross joins into a relation list + conjunct
+        pool, then greedily build a left-deep probe-heavy join tree."""
+        relations: List[PlanNode] = []
+        pool: List[RowExpression] = list(preds)
+
+        def flatten(n: PlanNode):
+            if isinstance(n, CrossJoinNode):
+                flatten(n.left)
+                flatten(n.right)
+            elif isinstance(n, JoinNode) and n.join_type == "inner":
+                flatten(n.left)
+                flatten(n.right)
+                for l, r in n.criteria:
+                    pool.append(Call(T.BOOLEAN, "eq", (l.ref(), r.ref())))
+                if n.filter_expr is not None:
+                    pool.extend(conjuncts(n.filter_expr))
+            elif isinstance(n, FilterNode):
+                pool.extend(conjuncts(n.predicate))
+                flatten(n.source)
+            else:
+                relations.append(n)
+
+        flatten(node)
+
+        # push single-relation conjuncts into their relation
+        rel_syms = [{s.name for s in r.output_symbols} for r in relations]
+        remaining: List[RowExpression] = []
+        per_rel: List[List[RowExpression]] = [[] for _ in relations]
+        for p in pool:
+            refs = referenced_symbols(p)
+            placed = False
+            for i, syms in enumerate(rel_syms):
+                if refs <= syms:
+                    per_rel[i].append(p)
+                    placed = True
+                    break
+            if not placed:
+                remaining.append(p)
+        relations = [self.push_filters(r, ps)
+                     for r, ps in zip(relations, per_rel)]
+
+        if len(relations) == 1:
+            return _apply(relations[0], remaining)
+
+        # estimated sizes (stats * filter selectivity)
+        sizes = [self._estimate_rows(r, len(ps))
+                 for r, ps in zip(relations, per_rel)]
+
+        # greedy: start from the largest (probe side stays streaming),
+        # repeatedly join the smallest connected relation as build side
+        order = sorted(range(len(relations)), key=lambda i: -sizes[i])
+        joined_idx = {order[0]}
+        plan = relations[order[0]]
+        available = {s.name for s in plan.output_symbols}
+        unjoined = [i for i in order[1:]]
+        residuals = list(remaining)
+
+        def equi_edges(avail: Set[str], cand_syms: Set[str]):
+            eqs = []
+            for p in residuals:
+                if isinstance(p, Call) and p.name == "eq":
+                    a, b = p.args
+                    if isinstance(a, SymbolRef) and isinstance(b, SymbolRef):
+                        if a.name in avail and b.name in cand_syms:
+                            eqs.append((Symbol(a.name, a.type),
+                                        Symbol(b.name, b.type), p))
+                        elif b.name in avail and a.name in cand_syms:
+                            eqs.append((Symbol(b.name, b.type),
+                                        Symbol(a.name, a.type), p))
+            return eqs
+
+        while unjoined:
+            best = None
+            for i in unjoined:
+                cand_syms = rel_syms[i]
+                eqs = equi_edges(available, cand_syms)
+                if eqs:
+                    if best is None or sizes[i] < sizes[best[0]]:
+                        best = (i, eqs)
+            if best is None:
+                # no connected relation: cross join the smallest
+                i = min(unjoined, key=lambda j: sizes[j])
+                plan = self._cross_join(plan, relations[i])
+            else:
+                i, eqs = best
+                criteria = [(l, r) for l, r, _ in eqs]
+                used = {id(p) for _, _, p in eqs}
+                residuals = [p for p in residuals if id(p) not in used]
+                plan = JoinNode("inner", plan, relations[i], criteria)
+            unjoined.remove(i)
+            available |= rel_syms[i]
+            # attach any residual now fully available
+            attachable = [p for p in residuals
+                          if referenced_symbols(p) <= available]
+            if attachable:
+                residuals = [p for p in residuals if p not in attachable]
+                plan = _apply(plan, attachable)
+        return _apply(plan, residuals)
+
+    def _cross_join(self, left: PlanNode, right: PlanNode) -> JoinNode:
+        """Cross join as an equi join on a constant key (single-row or
+        small build sides only in practice)."""
+        lk = self.allocator.new_symbol("cj", T.BIGINT)
+        rk = self.allocator.new_symbol("cj", T.BIGINT)
+        lproj = ProjectNode(left, [(s, s.ref())
+                                   for s in left.output_symbols]
+                            + [(lk, Literal(T.BIGINT, 0))])
+        rproj = ProjectNode(right, [(s, s.ref())
+                                    for s in right.output_symbols]
+                            + [(rk, Literal(T.BIGINT, 0))])
+        return JoinNode("inner", lproj, rproj, [(lk, rk)])
+
+    def _estimate_rows(self, node: PlanNode, num_filters: int) -> float:
+        base = self._base_rows(node)
+        return base * (FILTER_SELECTIVITY ** num_filters)
+
+    def _base_rows(self, node: PlanNode) -> float:
+        if isinstance(node, TableScanNode):
+            conn = self.metadata.connectors.get(node.catalog)
+            if conn is not None:
+                stats = conn.metadata().get_statistics(node.table)
+                if getattr(stats, "row_count", None):
+                    return float(stats.row_count)
+            return DEFAULT_ROWS
+        if isinstance(node, AggregationNode):
+            return self._base_rows(node.source) * 0.1
+        if isinstance(node, (FilterNode,)):
+            return self._base_rows(node.source) * FILTER_SELECTIVITY
+        if isinstance(node, ValuesNode):
+            return float(len(node.rows))
+        if isinstance(node, EnforceSingleRowNode):
+            return 1.0
+        if isinstance(node, JoinNode):
+            if node.join_type in ("semi", "anti"):
+                return self._base_rows(node.left) * 0.5
+            return max(self._base_rows(node.left),
+                       self._base_rows(node.right))
+        srcs = node.sources
+        if not srcs:
+            return DEFAULT_ROWS
+        return max(self._base_rows(s) for s in srcs)
+
+    # ------------------------------------------------------------------
+    # column pruning
+
+    def prune(self, node: PlanNode, required: Set[str]) -> PlanNode:
+        if isinstance(node, ProjectNode):
+            kept = [(s, e) for s, e in node.assignments
+                    if s.name in required]
+            if not kept:
+                kept = node.assignments[:1]
+            need = set()
+            for _, e in kept:
+                need |= referenced_symbols(e)
+            src = self.prune(node.source, need)
+            return ProjectNode(src, kept)
+
+        if isinstance(node, FilterNode):
+            need = required | referenced_symbols(node.predicate)
+            return FilterNode(self.prune(node.source, need), node.predicate)
+
+        if isinstance(node, TableScanNode):
+            kept = [(s, c) for s, c in node.assignments
+                    if s.name in required]
+            if not kept:
+                kept = node.assignments[:1]
+            return TableScanNode(node.catalog, node.table, kept)
+
+        if isinstance(node, JoinNode):
+            need = set(required)
+            for l, r in node.criteria:
+                need.add(l.name)
+                need.add(r.name)
+            if node.filter_expr is not None:
+                need |= referenced_symbols(node.filter_expr)
+            left_syms = {s.name for s in node.left.output_symbols}
+            right_syms = {s.name for s in node.right.output_symbols}
+            left = self.prune(node.left, need & left_syms)
+            right = self.prune(node.right, need & right_syms)
+            return JoinNode(node.join_type, left, right, node.criteria,
+                            node.filter_expr)
+
+        if isinstance(node, CrossJoinNode):
+            left_syms = {s.name for s in node.left.output_symbols}
+            right_syms = {s.name for s in node.right.output_symbols}
+            return CrossJoinNode(self.prune(node.left, required & left_syms),
+                                 self.prune(node.right,
+                                            required & right_syms))
+
+        if isinstance(node, AggregationNode):
+            kept_aggs = [(s, a) for s, a in node.aggregations
+                         if s.name in required]
+            if not kept_aggs and not node.group_keys:
+                kept_aggs = node.aggregations[:1]
+            need = {s.name for s in node.group_keys}
+            for _, a in kept_aggs:
+                if a.argument is not None:
+                    need.add(a.argument.name)
+            src = self.prune(node.source, need)
+            return AggregationNode(src, node.group_keys, kept_aggs,
+                                   node.step)
+
+        if isinstance(node, (SortNode, TopNNode)):
+            need = required | {o.symbol.name for o in node.orderings}
+            src = self.prune(node.sources[0], need)
+            return _replace_source(node, src)
+
+        if isinstance(node, (DistinctNode, IntersectNode, ExceptNode,
+                             UnionNode, ValuesNode, EnforceSingleRowNode)):
+            # set-semantics nodes need all their columns
+            new_sources = [self.prune(s, {x.name for x in s.output_symbols})
+                           for s in node.sources]
+            return _replace_sources(node, new_sources)
+
+        if isinstance(node, LimitNode):
+            return LimitNode(self.prune(node.source, required), node.count,
+                             node.offset)
+
+        new_sources = [self.prune(s, {x.name for x in s.output_symbols})
+                       for s in node.sources]
+        return _replace_sources(node, new_sources)
+
+    # ------------------------------------------------------------------
+
+    def cleanup(self, node: PlanNode) -> PlanNode:
+        """Remove identity projections; merge Filter(Filter)."""
+        new_sources = [self.cleanup(s) for s in node.sources]
+        node = _replace_sources(node, new_sources)
+        if isinstance(node, ProjectNode):
+            src = node.source
+            src_syms = [s.name for s in src.output_symbols]
+            if [s.name for s, _ in node.assignments] == src_syms and all(
+                    isinstance(e, SymbolRef) and e.name == s.name
+                    for s, e in node.assignments):
+                return src
+            # merge Project(Project) by inlining
+            if isinstance(src, ProjectNode):
+                mapping = {s.name: e for s, e in src.assignments}
+                merged = [(s, rewrite_symbols(e, mapping))
+                          for s, e in node.assignments]
+                return ProjectNode(src.source, merged)
+        if isinstance(node, FilterNode) and isinstance(node.source,
+                                                       FilterNode):
+            inner = node.source
+            pred = combine_conjuncts(conjuncts(node.predicate)
+                                     + conjuncts(inner.predicate))
+            return FilterNode(inner.source, pred)
+        return node
+
+
+# ---------------------------------------------------------------------------
+
+
+def _apply(node: PlanNode, preds: Sequence[RowExpression]) -> PlanNode:
+    pred = combine_conjuncts(list(preds))
+    if pred is None:
+        return node
+    return FilterNode(node, pred)
+
+
+def _replace_source(node: PlanNode, src: PlanNode) -> PlanNode:
+    return _replace_sources(node, [src])
+
+
+def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
+    if isinstance(node, FilterNode):
+        return FilterNode(sources[0], node.predicate)
+    if isinstance(node, ProjectNode):
+        return ProjectNode(sources[0], node.assignments)
+    if isinstance(node, AggregationNode):
+        return AggregationNode(sources[0], node.group_keys,
+                               node.aggregations, node.step)
+    if isinstance(node, JoinNode):
+        return JoinNode(node.join_type, sources[0], sources[1],
+                        node.criteria, node.filter_expr)
+    if isinstance(node, CrossJoinNode):
+        return CrossJoinNode(sources[0], sources[1])
+    if isinstance(node, SortNode):
+        return SortNode(sources[0], node.orderings)
+    if isinstance(node, TopNNode):
+        return TopNNode(sources[0], node.orderings, node.count)
+    if isinstance(node, LimitNode):
+        return LimitNode(sources[0], node.count, node.offset)
+    if isinstance(node, DistinctNode):
+        return DistinctNode(sources[0])
+    if isinstance(node, EnforceSingleRowNode):
+        return EnforceSingleRowNode(sources[0])
+    if isinstance(node, UnionNode):
+        return UnionNode(node.symbols, sources)
+    if isinstance(node, IntersectNode):
+        return IntersectNode(node.symbols, sources)
+    if isinstance(node, ExceptNode):
+        return ExceptNode(node.symbols, sources)
+    if isinstance(node, OutputNode):
+        return OutputNode(sources[0], node.column_names, node.outputs)
+    if isinstance(node, (TableScanNode, ValuesNode)):
+        return node
+    raise AssertionError(f"unknown node {type(node).__name__}")

@@ -1,0 +1,362 @@
+"""Logical plan nodes.
+
+Reference analog: ``sql/planner/plan/`` (60 node classes). The subset here
+covers the engine's executable surface; every node lists its output
+symbols, and expressions are RowExpressions over SymbolRefs
+(``planner/symbols.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .. import types as T
+from ..connectors.spi import ColumnHandle, TableHandle
+from ..expr.ir import RowExpression
+from .symbols import Symbol
+
+
+class PlanNode:
+    @property
+    def sources(self) -> List["PlanNode"]:
+        return []
+
+    @property
+    def output_symbols(self) -> List[Symbol]:
+        raise NotImplementedError
+
+
+@dataclass
+class TableScanNode(PlanNode):
+    """Reference: sql/planner/plan/TableScanNode.java"""
+
+    catalog: str
+    table: TableHandle
+    assignments: List[Tuple[Symbol, ColumnHandle]]
+
+    @property
+    def output_symbols(self):
+        return [s for s, _ in self.assignments]
+
+
+@dataclass
+class ValuesNode(PlanNode):
+    """Reference: sql/planner/plan/ValuesNode.java"""
+
+    symbols: List[Symbol]
+    rows: List[List[RowExpression]]  # literal rows
+
+    @property
+    def output_symbols(self):
+        return list(self.symbols)
+
+
+@dataclass
+class FilterNode(PlanNode):
+    """Reference: sql/planner/plan/FilterNode.java"""
+
+    source: PlanNode
+    predicate: RowExpression
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+
+@dataclass
+class ProjectNode(PlanNode):
+    """Reference: sql/planner/plan/ProjectNode.java"""
+
+    source: PlanNode
+    assignments: List[Tuple[Symbol, RowExpression]]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return [s for s, _ in self.assignments]
+
+    def is_identity(self) -> bool:
+        from .symbols import SymbolRef
+
+        src = self.source.output_symbols
+        if len(self.assignments) != len(src):
+            return False
+        return all(isinstance(e, SymbolRef) and e.name == out.name == s.name
+                   for (out, e), s in zip(self.assignments, src))
+
+
+@dataclass(frozen=True)
+class Aggregation:
+    """One aggregate call (reference: plan/AggregationNode.Aggregation)."""
+
+    function: str                       # count|count_star|sum|avg|min|max|...
+    argument: Optional[Symbol]          # pre-projected input symbol
+    distinct: bool = False
+    # filter/mask arrives later (FILTER clause)
+
+
+@dataclass
+class AggregationNode(PlanNode):
+    """Reference: sql/planner/plan/AggregationNode.java"""
+
+    source: PlanNode
+    group_keys: List[Symbol]
+    aggregations: List[Tuple[Symbol, Aggregation]]
+    step: str = "single"  # single | partial | final
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return list(self.group_keys) + [s for s, _ in self.aggregations]
+
+
+@dataclass
+class JoinNode(PlanNode):
+    """Reference: sql/planner/plan/JoinNode.java. ``join_type`` inner|left|
+    semi|anti (right/full are normalized away by the planner; semi/anti
+    carry probe=left output only). ``criteria`` is equi-key pairs
+    (left_symbol, right_symbol); ``filter_expr`` is a residual applied to
+    the joined row (over left+right symbols)."""
+
+    join_type: str
+    left: PlanNode
+    right: PlanNode
+    criteria: List[Tuple[Symbol, Symbol]]
+    filter_expr: Optional[RowExpression] = None
+
+    @property
+    def sources(self):
+        return [self.left, self.right]
+
+    @property
+    def output_symbols(self):
+        if self.join_type in ("semi", "anti"):
+            return self.left.output_symbols
+        return self.left.output_symbols + self.right.output_symbols
+
+
+@dataclass
+class CrossJoinNode(PlanNode):
+    """Pre-optimization implicit join (FROM a, b). The optimizer converts
+    these + WHERE equi-conjuncts into JoinNodes (reference analog: implicit
+    joins arrive as CROSS JOIN + filter and are rewritten by
+    PredicatePushDown + ReorderJoins)."""
+
+    left: PlanNode
+    right: PlanNode
+
+    @property
+    def sources(self):
+        return [self.left, self.right]
+
+    @property
+    def output_symbols(self):
+        return self.left.output_symbols + self.right.output_symbols
+
+
+@dataclass(frozen=True)
+class Ordering:
+    symbol: Symbol
+    ascending: bool = True
+    nulls_last: Optional[bool] = None  # None = SQL default for direction
+
+
+@dataclass
+class SortNode(PlanNode):
+    """Reference: sql/planner/plan/SortNode.java"""
+
+    source: PlanNode
+    orderings: List[Ordering]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+
+@dataclass
+class TopNNode(PlanNode):
+    """Reference: sql/planner/plan/TopNNode.java"""
+
+    source: PlanNode
+    orderings: List[Ordering]
+    count: int
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+
+@dataclass
+class LimitNode(PlanNode):
+    """Reference: sql/planner/plan/LimitNode.java (+OffsetNode)"""
+
+    source: PlanNode
+    count: Optional[int]
+    offset: int = 0
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+
+@dataclass
+class DistinctNode(PlanNode):
+    """SELECT DISTINCT — executes as grouping with no aggregates
+    (reference: AggregationNode with empty aggregations)."""
+
+    source: PlanNode
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+
+@dataclass
+class UnionNode(PlanNode):
+    """Reference: sql/planner/plan/UnionNode.java. Each source's outputs
+    positionally map to this node's symbols."""
+
+    symbols: List[Symbol]
+    inputs: List[PlanNode]
+
+    @property
+    def sources(self):
+        return list(self.inputs)
+
+    @property
+    def output_symbols(self):
+        return list(self.symbols)
+
+
+@dataclass
+class IntersectNode(PlanNode):
+    """INTERSECT [DISTINCT] (reference: plan/IntersectNode.java)."""
+
+    symbols: List[Symbol]
+    inputs: List[PlanNode]
+
+    @property
+    def sources(self):
+        return list(self.inputs)
+
+    @property
+    def output_symbols(self):
+        return list(self.symbols)
+
+
+@dataclass
+class ExceptNode(PlanNode):
+    """EXCEPT [DISTINCT] (reference: plan/ExceptNode.java)."""
+
+    symbols: List[Symbol]
+    inputs: List[PlanNode]
+
+    @property
+    def sources(self):
+        return list(self.inputs)
+
+    @property
+    def output_symbols(self):
+        return list(self.symbols)
+
+
+@dataclass
+class EnforceSingleRowNode(PlanNode):
+    """Scalar subquery guard: errors on >1 row, emits a NULL row on 0
+    (reference: plan/EnforceSingleRowNode.java)."""
+
+    source: PlanNode
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+
+@dataclass
+class OutputNode(PlanNode):
+    """Reference: sql/planner/plan/OutputNode.java"""
+
+    source: PlanNode
+    column_names: List[str]
+    outputs: List[Symbol]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return list(self.outputs)
+
+
+# ---------------------------------------------------------------------------
+
+
+def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
+    """EXPLAIN rendering (reference analog: planprinter/PlanPrinter.java)."""
+    pad = "  " * indent
+    name = type(node).__name__.replace("Node", "")
+    detail = ""
+    if isinstance(node, TableScanNode):
+        detail = f" {node.table.qualified_name}" \
+                 f" {[s.name for s, _ in node.assignments]}"
+    elif isinstance(node, FilterNode):
+        detail = f" {node.predicate!r}"
+    elif isinstance(node, ProjectNode):
+        detail = " " + ", ".join(f"{s.name}:={e!r}"
+                                 for s, e in node.assignments)
+    elif isinstance(node, AggregationNode):
+        detail = (f" keys={[s.name for s in node.group_keys]} " +
+                  ", ".join(f"{s.name}:={a.function}"
+                            f"({a.argument.name if a.argument else '*'})"
+                            for s, a in node.aggregations))
+    elif isinstance(node, JoinNode):
+        detail = f" {node.join_type} on " + ", ".join(
+            f"{l.name}={r.name}" for l, r in node.criteria)
+        if node.filter_expr is not None:
+            detail += f" filter {node.filter_expr!r}"
+    elif isinstance(node, (SortNode, TopNNode)):
+        detail = " " + ", ".join(
+            f"{o.symbol.name} {'asc' if o.ascending else 'desc'}"
+            for o in node.orderings)
+        if isinstance(node, TopNNode):
+            detail += f" limit {node.count}"
+    elif isinstance(node, LimitNode):
+        detail = f" {node.count} offset {node.offset}"
+    elif isinstance(node, OutputNode):
+        detail = f" {node.column_names}"
+    out = f"{pad}- {name}{detail}\n"
+    for s in node.sources:
+        out += plan_tree_str(s, indent + 1)
+    return out

@@ -1,0 +1,114 @@
+"""LocalQueryRunner: full engine (parser -> planner -> operators) in one
+process.
+
+Reference analog: ``core/trino-main/.../testing/LocalQueryRunner.java:254``
+— the single-node, no-HTTP engine used for fast correctness tests and
+operator benchmarks. The distributed runner builds on the same planner
+with exchanges between fragments (parallel/ package).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+from . import types as T
+from .block import Page
+from .connectors.spi import Connector
+from .exec.local_planner import LocalExecutionPlanner
+from .planner.logical_planner import LogicalPlanner, Metadata
+from .planner.optimizer import optimize
+from .planner.plan import OutputNode, plan_tree_str
+from .sql import ast
+from .sql.analyzer import AnalysisError, Session
+from .sql.parser import parse_statement
+
+
+@dataclass
+class QueryResult:
+    column_names: List[str]
+    types: List[T.Type]
+    rows: List[tuple]
+
+    def only_value(self):
+        assert len(self.rows) == 1 and len(self.rows[0]) == 1, self.rows
+        return self.rows[0][0]
+
+
+class LocalQueryRunner:
+    def __init__(self, connectors: Dict[str, Connector],
+                 session: Optional[Session] = None,
+                 desired_splits: int = 4):
+        self.metadata = Metadata(connectors)
+        self.session = session or Session(
+            catalog=next(iter(connectors), None))
+        self.desired_splits = desired_splits
+
+    # ------------------------------------------------------------------
+
+    def create_plan(self, sql: str) -> OutputNode:
+        stmt = parse_statement(sql)
+        return self.plan_statement(stmt)
+
+    def plan_statement(self, stmt: ast.Statement) -> OutputNode:
+        planner = LogicalPlanner(self.metadata, self.session)
+        root = planner.plan(stmt)
+        return optimize(root, self.metadata, planner.allocator)
+
+    def explain(self, sql: str) -> str:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, ast.Explain):
+            stmt = stmt.statement
+        return plan_tree_str(self.plan_statement(stmt))
+
+    def execute(self, sql: str) -> QueryResult:
+        stmt = parse_statement(sql)
+        if isinstance(stmt, ast.Explain):
+            text = plan_tree_str(self.plan_statement(stmt.statement))
+            return QueryResult(["Query Plan"], [T.VARCHAR],
+                               [(line,) for line in text.splitlines()])
+        if isinstance(stmt, ast.ShowCatalogs):
+            return QueryResult(["Catalog"], [T.VARCHAR],
+                               [(c,) for c in
+                                sorted(self.metadata.connectors)])
+        if isinstance(stmt, ast.ShowSchemas):
+            catalog = stmt.catalog or self.session.catalog
+            conn = self._connector(catalog)
+            return QueryResult(["Schema"], [T.VARCHAR],
+                               [(s,) for s in
+                                sorted(conn.metadata().list_schemas())])
+        if isinstance(stmt, ast.ShowTables):
+            catalog = self.session.catalog
+            schema = self.session.schema
+            if stmt.schema:
+                parts = stmt.schema
+                schema = parts[-1]
+                if len(parts) > 1:
+                    catalog = parts[-2]
+            conn = self._connector(catalog)
+            return QueryResult(["Table"], [T.VARCHAR],
+                               [(t,) for t in
+                                sorted(conn.metadata().list_tables(schema))])
+        if isinstance(stmt, ast.ShowColumns):
+            resolved = self.metadata.resolve_table(stmt.table, self.session)
+            if resolved is None:
+                raise AnalysisError(
+                    "table '%s' does not exist" % ".".join(stmt.table))
+            _, _, _, columns = resolved
+            return QueryResult(
+                ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
+                [(c.name, str(c.type)) for c in columns])
+        root = self.plan_statement(stmt)
+        local = LocalExecutionPlanner(self.metadata, self.desired_splits)
+        plan = local.plan(root)
+        pages = plan.execute()
+        rows: List[tuple] = []
+        for p in pages:
+            rows.extend(p.to_rows())
+        return QueryResult(plan.column_names, plan.output_types, rows)
+
+    def _connector(self, catalog: Optional[str]) -> Connector:
+        conn = self.metadata.connectors.get(catalog or "")
+        if conn is None:
+            raise AnalysisError(f"catalog '{catalog}' does not exist")
+        return conn
