@@ -251,3 +251,30 @@ def test_group_by_select_alias_expression(runner):
         select n_regionkey + 1 as a, count(*) from nation
         group by a order by a""")
     assert rows == [(1, 5), (2, 5), (3, 5), (4, 5), (5, 5)]
+
+
+def test_order_by_expression_over_alias(runner):
+    rows = q(runner, "select n_nationkey + 1 as c from nation "
+                     "order by c + 1 desc limit 2")
+    assert rows == [(25,), (24,)]
+
+
+def test_string_not_in_with_null_item(runner):
+    rows = q(runner, "select count(*) from nation "
+                     "where n_name not in ('ALGERIA', null)")
+    assert rows == [(0,)]
+
+
+def test_string_in_with_null_item(runner):
+    rows = q(runner, "select count(*) from nation "
+                     "where n_name in ('ALGERIA', null)")
+    assert rows == [(1,)]
+
+
+def test_string_in_type_mismatch_raises(runner):
+    import pytest as _pytest
+
+    from trino_tpu.sql.analyzer import AnalysisError
+
+    with _pytest.raises(AnalysisError):
+        q(runner, "select count(*) from nation where n_name in (1, 2)")

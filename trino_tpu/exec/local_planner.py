@@ -183,10 +183,6 @@ class LocalExecutionPlanner:
 
         filter_fn = None
         if filter_expr is not None:
-            if join_type in ("semi", "anti"):
-                raise TrinoError(
-                    "filtered semi/anti join not supported yet",
-                    "NOT_SUPPORTED")
             combined_layout = dict(playout)
             for name, ch in blayout.items():
                 combined_layout[name] = len(ptypes) + ch

@@ -432,7 +432,11 @@ class PageProcessor:
                     raise TypeError_("string IN list must be literals")
                 lits.append(it.value)
             view = self._str_view(value)
-            lit_set = set(lits)
+            # SQL three-valued IN: a NULL list item makes non-matches
+            # NULL (never FALSE) — so NOT IN over a list with NULL keeps
+            # nothing
+            has_null_item = any(v is None for v in lits)
+            lit_set = set(v for v in lits if v is not None)
 
             def fill(dicts):
                 vals = view.values(dicts)
@@ -444,7 +448,11 @@ class PageProcessor:
             nulls = self._string_nulls_plan(value)
 
             def ev(env):
-                return env["luts"][slot][codes(env)], _nz_opt(nulls(env))
+                matched = env["luts"][slot][codes(env)]
+                null = _nz_opt(nulls(env))
+                if has_null_item:
+                    null = _nz(null) | ~matched
+                return matched, null
 
             return ev
 

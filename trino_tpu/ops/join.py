@@ -277,11 +277,24 @@ class LookupJoinOperator(Operator):
                                       pusable)
             total = int(jnp.sum(count))
             cap = padded_size(max(total, 16))
-            matched = _semi_matched(
-                lo, count,
-                tuple(page.cols[c] for c in kc),
-                tuple(b.cols[c] for c in b.key_channels),
-                page.valid.shape[0], out_cap=cap)
+            if self.filter_fn is None:
+                matched = _semi_matched(
+                    lo, count,
+                    tuple(page.cols[c] for c in kc),
+                    tuple(b.cols[c] for c in b.key_channels),
+                    page.valid.shape[0], out_cap=cap)
+            else:
+                # residual-filtered semi/anti (q21's l3.l_suppkey <>
+                # l1.l_suppkey): expand candidate lanes, verify keys,
+                # evaluate the filter over the combined probe+build row,
+                # then segment-OR back onto probe rows
+                probe_idx, build_idx, keep = _expand_verified(
+                    lo, count,
+                    tuple(page.cols[c] for c in kc),
+                    tuple(b.cols[c] for c in b.key_channels), out_cap=cap)
+                lanes = _gather_lanes(page, b, probe_idx, build_idx, keep)
+                matched = _segment_any(self.filter_fn(lanes).valid,
+                                       probe_idx, page.valid.shape[0])
             if self.join_type == "semi":
                 new_valid = page.valid & matched
             else:
@@ -291,60 +304,86 @@ class LookupJoinOperator(Operator):
 
         lo, count = _probe_counts(b.key_sorted, b.usable_sorted, pkey,
                                   pusable)
-        total = int(jnp.max(jnp.cumsum(count)))  # device sync: exact size
-        extra = page.capacity if self.join_type == "left" else 0
-        out_cap = padded_size(max(total + extra, 16))
-        out = _emit_join(
+        total = int(jnp.sum(count))  # device sync: exact candidate count
+        lane_cap = padded_size(max(total, 16))
+        probe_idx, build_idx, keep = _expand_verified(
+            lo, count,
+            tuple(page.cols[c] for c in kc),
+            tuple(b.cols[c] for c in b.key_channels), out_cap=lane_cap)
+        if self.filter_fn is not None:
+            # ON-clause residual runs BEFORE left-join padding: lanes
+            # failing it make the probe row unmatched, not dropped
+            lanes = _gather_lanes(page, b, probe_idx, build_idx, keep)
+            keep = self.filter_fn(lanes).valid
+        out_cols, out_nulls, out_valid = _finalize_join(
             tuple(page.cols), tuple(page.nulls), page.valid,
             tuple(b.cols), tuple(b.nulls),
-            tuple(page.cols[c] for c in kc),
-            tuple(b.cols[c] for c in b.key_channels),
-            lo, count, pusable,
-            out_cap=out_cap, left=self.join_type == "left")
-        out_cols, out_nulls, out_valid = out
+            probe_idx, build_idx, keep,
+            left=self.join_type == "left")
         types = self.output_types
         dicts = list(page.dictionaries) + list(b.dictionaries)
-        result = DevicePage(types, list(out_cols), list(out_nulls),
-                            out_valid, dicts)
-        if self.filter_fn is not None:
-            result = self.filter_fn(result)
-        return result
+        return DevicePage(types, list(out_cols), list(out_nulls),
+                          out_valid, dicts)
 
 
-@partial(jax.jit, static_argnames=("out_cap", "left"))
-def _emit_join(pcols, pnulls, pvalid, bcols, bnulls, pkey_cols, bkey_cols,
-               lo, count, pusable, out_cap: int, left: bool):
-    probe_idx, build_idx, lane_valid = _expand_matches(lo, count, out_cap)
-    # verify candidates against raw keys (hash collisions -> drop lane)
-    keep = lane_valid
-    for pc, bc in zip(pkey_cols, bkey_cols):
-        keep = keep & (pc[probe_idx] == bc[build_idx])
+@partial(jax.jit, static_argnames=("left",))
+def _finalize_join(pcols, pnulls, pvalid, bcols, bnulls,
+                   probe_idx, build_idx, keep, left: bool):
+    """Gather joined output lanes; for LEFT, append one lane per probe
+    row, valid iff the row matched no kept lane (NULL build columns)."""
+    lane_cap = probe_idx.shape[0]
     if left:
-        # matched probe rows: OR of keep per probe row
-        matched = jnp.zeros(pvalid.shape[0] + 1, dtype=bool)
-        matched = matched.at[jnp.where(keep, probe_idx, pvalid.shape[0])] \
-            .max(True)
-        matched = matched[:-1]
-        # append one lane per unmatched live probe row
+        matched = _segment_any(keep, probe_idx, pvalid.shape[0])
         n_extra = pvalid.shape[0]
-        extra_probe = jnp.arange(n_extra, dtype=jnp.int32)
-        extra_valid = pvalid & ~matched
-        probe_idx = jnp.concatenate([probe_idx[:out_cap - n_extra],
-                                     extra_probe])
-        keep = jnp.concatenate([keep[:out_cap - n_extra], extra_valid])
+        extra_probe = jnp.arange(n_extra, dtype=probe_idx.dtype)
+        probe_idx = jnp.concatenate([probe_idx, extra_probe])
+        build_idx = jnp.concatenate(
+            [build_idx, jnp.zeros(n_extra, dtype=build_idx.dtype)])
+        keep = jnp.concatenate([keep, pvalid & ~matched])
         build_is_null = jnp.concatenate(
-            [jnp.zeros(out_cap - n_extra, dtype=bool),
+            [jnp.zeros(lane_cap, dtype=bool),
              jnp.ones(n_extra, dtype=bool)])
-        build_idx = jnp.concatenate([build_idx[:out_cap - n_extra],
-                                     jnp.zeros(n_extra, dtype=jnp.int32)])
     else:
-        build_is_null = jnp.zeros(out_cap, dtype=bool)
+        build_is_null = jnp.zeros(lane_cap, dtype=bool)
 
     out_cols = tuple(c[probe_idx] for c in pcols) + \
         tuple(c[build_idx] for c in bcols)
     out_nulls = tuple(n[probe_idx] for n in pnulls) + \
         tuple(n[build_idx] | build_is_null for n in bnulls)
     return out_cols, out_nulls, keep
+
+
+def _gather_lanes(page: DevicePage, b: "BuildSide", probe_idx, build_idx,
+                  keep) -> DevicePage:
+    """Combined probe+build rows for candidate lanes (residual-filter
+    evaluation layout: probe channels, then build channels)."""
+    return DevicePage(
+        list(page.types) + list(b.types),
+        [c[probe_idx] for c in page.cols]
+        + [c[build_idx] for c in b.cols],
+        [n[probe_idx] for n in page.nulls]
+        + [n[build_idx] for n in b.nulls],
+        keep,
+        list(page.dictionaries) + list(b.dictionaries))
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _expand_verified(lo, count, pkey_cols, bkey_cols, out_cap: int):
+    """Candidate lanes with raw-key verification applied (for
+    residual-filtered semi/anti joins)."""
+    probe_idx, build_idx, lane_valid = _expand_matches(lo, count, out_cap)
+    keep = lane_valid
+    for pc, bc in zip(pkey_cols, bkey_cols):
+        keep = keep & (pc[probe_idx] == bc[build_idx])
+    return probe_idx, build_idx, keep
+
+
+@partial(jax.jit, static_argnames=("probe_cap",))
+def _segment_any(keep, probe_idx, probe_cap: int):
+    """OR of ``keep`` lanes per probe row."""
+    matched = jnp.zeros(probe_cap + 1, dtype=bool)
+    matched = matched.at[jnp.where(keep, probe_idx, probe_cap)].max(True)
+    return matched[:-1]
 
 
 @partial(jax.jit, static_argnames=("probe_cap", "out_cap"))

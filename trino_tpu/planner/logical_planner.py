@@ -361,12 +361,15 @@ class QueryPlanner:
             rp, replacements = self.plan_aggregation(
                 rp, group_exprs or [], agg_calls, select_exprs)
 
-        # HAVING
+        # HAVING (subqueries allowed — q11's having > (select ...))
         if spec.having is not None:
-            analyzer = ExpressionAnalyzer(rp.scope, self.ctx.session,
-                                          replacements=replacements)
+            having_state = _HookState(rp)
+            analyzer = ExpressionAnalyzer(
+                rp.scope, self.ctx.session, replacements=replacements,
+                subquery_hook=self._scalar_subquery_hook(having_state))
             pred = coerce(analyzer.analyze(spec.having), T.BOOLEAN)
-            rp = RelationPlan(FilterNode(rp.node, pred), rp.scope)
+            rp = RelationPlan(FilterNode(having_state.rp.node, pred),
+                              having_state.rp.scope)
 
         # SELECT projections
         hook_state = _HookState(rp)
@@ -509,7 +512,25 @@ class QueryPlanner:
             replacements[call] = out_sym
 
         pre = ProjectNode(rp.node, pre_assignments)
-        agg_node = AggregationNode(pre, group_keys, aggregations)
+        if any(a.distinct for _, a in aggregations):
+            # single-distinct rewrite (reference:
+            # iterative/rule/SingleDistinctAggregationToGroupBy.java):
+            # agg(distinct x) group by k  ==>  inner group by (k, x),
+            # then agg(x) group by k. Requires every aggregate distinct
+            # on the same argument.
+            args = {a.argument for _, a in aggregations}
+            if not all(a.distinct for _, a in aggregations) or \
+                    len(args) != 1 or None in args:
+                raise AnalysisError(
+                    "mixed DISTINCT/non-DISTINCT or multi-argument "
+                    "DISTINCT aggregates not supported yet")
+            arg = next(iter(args))
+            inner = AggregationNode(pre, group_keys + [arg], [])
+            aggregations = [(s, Aggregation(a.function, a.argument, False))
+                            for s, a in aggregations]
+            agg_node = AggregationNode(inner, group_keys, aggregations)
+        else:
+            agg_node = AggregationNode(pre, group_keys, aggregations)
         fields = [FieldDef(s.name, s) for s in agg_node.output_symbols]
         # keep original field names for group keys resolvable
         name_of = {}
@@ -826,11 +847,20 @@ class QueryPlanner:
             # extend grouping with inner correlation symbols
             agg_node = rp2.node
             assert isinstance(agg_node, AggregationNode)
-            pre: ProjectNode = agg_node.source
+            pre = agg_node.source
+            inner_agg = None
+            if isinstance(pre, AggregationNode):
+                # single-distinct rewrite inserted a grouping level
+                inner_agg = pre
+                pre = inner_agg.source
+            assert isinstance(pre, ProjectNode)
             for outer_sym, inner_sym in equi_pairs:
                 if not any(s.name == inner_sym.name
                            for s, _ in pre.assignments):
                     pre.assignments.append((inner_sym, inner_sym.ref()))
+                if inner_agg is not None and \
+                        inner_sym not in inner_agg.group_keys:
+                    inner_agg.group_keys.append(inner_sym)
                 if inner_sym not in agg_node.group_keys:
                     agg_node.group_keys.append(inner_sym)
             rp2 = RelationPlan(agg_node, Scope(
@@ -963,7 +993,12 @@ class QueryPlanner:
                         f.symbol.name == expr.name for f in rp.scope.fields):
                     sym = Symbol(expr.name, expr.type)
                 elif proj_node is not None:
-                    # evaluate within the projection, keep hidden
+                    # evaluate within the projection, keep hidden. The
+                    # expression may reference projection OUTPUTS (select
+                    # aliases) — inline those through the assignments so
+                    # it only names the projection's source symbols
+                    out_map = {s.name: e for s, e in proj_node.assignments}
+                    expr = rewrite_symbols(expr, out_map)
                     if isinstance(expr, SymbolRef):
                         sym = Symbol(expr.name, expr.type)
                         if not any(s.name == sym.name

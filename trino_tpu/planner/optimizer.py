@@ -155,15 +155,15 @@ class Optimizer:
                     break
             if not placed:
                 remaining.append(p)
+        # estimate sizes BEFORE wrapping in FilterNodes (else the pushed
+        # conjuncts would be double-counted by _base_rows)
+        sizes = [self._estimate_rows(r, len(ps))
+                 for r, ps in zip(relations, per_rel)]
         relations = [self.push_filters(r, ps)
                      for r, ps in zip(relations, per_rel)]
 
         if len(relations) == 1:
             return _apply(relations[0], remaining)
-
-        # estimated sizes (stats * filter selectivity)
-        sizes = [self._estimate_rows(r, len(ps))
-                 for r, ps in zip(relations, per_rel)]
 
         # greedy: start from the largest (probe side stays streaming),
         # repeatedly join the smallest connected relation as build side

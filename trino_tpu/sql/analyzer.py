@@ -336,9 +336,15 @@ class ExpressionAnalyzer:
         items = [self.analyze(x) for x in e.value_list]
         ct = v.type
         for it in items:
-            ct = common_type(ct, it.type, "IN")
-        if ct.is_string:
-            ct = v.type  # string IN compares values; keep channel type
+            ct = common_type(ct, it.type, "IN")  # raises on type mismatch
+        if v.type.is_string:
+            # string IN compares dictionary values host-side; items must
+            # stay bare literals (no casts — varchar lengths are erased)
+            for it in items:
+                if not isinstance(it, Literal):
+                    raise AnalysisError(
+                        "string IN list items must be literals")
+            return Call(T.BOOLEAN, "$in", tuple([v] + items))
         return Call(T.BOOLEAN, "$in",
                     tuple([coerce(v, ct)] + [coerce(i, ct) for i in items]))
 
