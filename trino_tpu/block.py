@@ -41,37 +41,49 @@ class Dictionary:
     object because device kernels only ever see codes.
     """
 
-    __slots__ = ("values", "_index", "_sort_rank")
+    __slots__ = ("values", "_index", "_sort_rank", "_lock")
 
     def __init__(self, values: Sequence[str] = ()):
+        import threading
+
         self.values: list = list(values)
         self._index = {v: i for i, v in enumerate(self.values)}
         self._sort_rank = None
+        self._lock = threading.Lock()
 
     @classmethod
     def aligned(cls, values: Sequence[str]) -> "Dictionary":
         """Pool whose position i maps to values[i] even when values repeat
         (derived pools from string transforms must stay code-aligned with
         their source). Lookup maps to the first occurrence."""
+        import threading
+
         d = cls.__new__(cls)
         d.values = list(values)
         d._index = {}
         for i, v in enumerate(d.values):
             d._index.setdefault(v, i)
         d._sort_rank = None
+        d._lock = threading.Lock()
         return d
 
     def __len__(self) -> int:
         return len(self.values)
 
     def code(self, value: str) -> int:
-        """Code for value, adding it to the pool if absent."""
+        """Code for value, adding it to the pool if absent. Thread-safe:
+        concurrent scan tasks of a distributed query grow shared
+        connector pools (check-then-append must not interleave)."""
         c = self._index.get(value)
-        if c is None:
-            c = len(self.values)
-            self.values.append(value)
-            self._index[value] = c
-            self._sort_rank = None
+        if c is not None:
+            return c
+        with self._lock:
+            c = self._index.get(value)
+            if c is None:
+                c = len(self.values)
+                self.values.append(value)
+                self._index[value] = c
+                self._sort_rank = None
         return c
 
     def lookup(self, value: str) -> int:

@@ -62,9 +62,19 @@ class LocalExecutionPlan:
 
 
 class LocalExecutionPlanner:
-    def __init__(self, metadata: Metadata, desired_splits: int = 4):
+    """``task_id``/``task_count`` assign a subset of table splits to this
+    task (reference: split assignment in SqlTaskExecution);
+    ``exchange_reader(fragment_id, kind) -> thunk`` resolves
+    RemoteSourceNodes to upstream fragment output pages."""
+
+    def __init__(self, metadata: Metadata, desired_splits: int = 4,
+                 task_id: int = 0, task_count: int = 1,
+                 exchange_reader=None):
         self.metadata = metadata
         self.desired_splits = desired_splits
+        self.task_id = task_id
+        self.task_count = task_count
+        self.exchange_reader = exchange_reader
         self.pipelines: List[PhysicalPipeline] = []
 
     def plan(self, root: OutputNode) -> LocalExecutionPlan:
@@ -98,9 +108,11 @@ class LocalExecutionPlanner:
         conn = self.metadata.connectors[node.catalog]
         columns = [c for _, c in node.assignments]
         scan = TableScanOperator(conn, columns)
-        for split in conn.split_manager().get_splits(node.table,
-                                                     self.desired_splits):
-            scan.add_split(split)
+        splits = conn.split_manager().get_splits(node.table,
+                                                 self.desired_splits)
+        for i, split in enumerate(splits):
+            if i % self.task_count == self.task_id:
+                scan.add_split(split)
         scan.no_more_splits()
         layout = {s.name: i for i, (s, _) in enumerate(node.assignments)}
         types_ = [s.type for s, _ in node.assignments]
@@ -217,10 +229,27 @@ class LocalExecutionPlanner:
                     "this one was not rewritten", "NOT_SUPPORTED")
             if a.argument is None:
                 aggs.append(AggCall("count_star", None, None, out_sym.type))
+            elif node.step == "final":
+                # input is the intermediate keys+states layout: states
+                # are positional, arg channel is not read
+                aggs.append(AggCall(a.function, None, a.argument.type,
+                                    out_sym.type))
             else:
                 ch = layout[a.argument.name]
                 aggs.append(AggCall(a.function, ch, types_[ch],
                                     out_sym.type))
+        if node.step == "final":
+            # the operator's final path expects keys at channels [0..k)
+            # then state columns — reorder if the source layout differs
+            in_syms = list(node.group_keys) + list(node.state_symbols or [])
+            want = [layout[s.name] for s in in_syms]
+            if want != list(range(len(want))) or len(want) != len(types_):
+                proj = [InputRef(types_[c], c) for c in want]
+                ops.append(FilterProjectOperator(
+                    PageProcessor(types_, proj)))
+                types_ = [types_[c] for c in want]
+                layout = {s.name: i for i, s in enumerate(in_syms)}
+                group_channels = list(range(len(node.group_keys)))
         op = HashAggregationOperator(types_, group_channels, aggs,
                                      step=node.step)
         ops.append(op)
@@ -230,9 +259,14 @@ class LocalExecutionPlanner:
             new_layout[s.name] = i
             out_types.append(types_[group_channels[i]])
         base = len(node.group_keys)
-        for j, (out_sym, _a) in enumerate(node.aggregations):
-            new_layout[out_sym.name] = base + j
-            out_types.append(out_sym.type)
+        if node.step == "partial":
+            for j, s in enumerate(node.state_symbols or []):
+                new_layout[s.name] = base + j
+                out_types.append(s.type)
+        else:
+            for j, (out_sym, _a) in enumerate(node.aggregations):
+                new_layout[out_sym.name] = base + j
+                out_types.append(out_sym.type)
         return ops, new_layout, out_types
 
     def _v_DistinctNode(self, node: DistinctNode):
@@ -297,6 +331,17 @@ class LocalExecutionPlanner:
         source = DeferredPagesSourceOperator(union_pages)
         layout = {s.name: i for i, s in enumerate(node.symbols)}
         return [source], layout, [s.type for s in node.symbols]
+
+    def _v_RemoteSourceNode(self, node):
+        assert self.exchange_reader is not None, \
+            "remote source outside distributed execution"
+        types_ = [s.type for s in node.symbols]
+        thunk = self.exchange_reader(node.fragment_id, node.kind)
+        from ..ops.output import ExchangeSourceOperator
+
+        source = ExchangeSourceOperator(thunk, types_)
+        layout = {s.name: i for i, s in enumerate(node.symbols)}
+        return [source], layout, types_
 
     def _v_IntersectNode(self, node: IntersectNode):
         return self._set_semantics_join(node, "semi")

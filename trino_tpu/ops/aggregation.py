@@ -105,6 +105,21 @@ def _state_plan(agg: AggCall):
     raise TypeError_(f"unknown aggregate function {f}")
 
 
+def intermediate_state_types(function: str,
+                             arg_type: Optional[T.Type]) -> List[T.Type]:
+    """SQL types of one aggregate's partial-state columns (the wire
+    layout of partial-aggregation exchange pages)."""
+    call = AggCall(function, None, arg_type, T.BIGINT)
+    out: List[T.Type] = []
+    for (kind, dt) in _state_plan(call):
+        if kind in ("min", "max"):
+            out.append(T.DOUBLE if arg_type in (T.REAL, T.DOUBLE)
+                       else (arg_type or T.BIGINT))
+        else:
+            out.append(T.DOUBLE if dt == jnp.float64 else T.BIGINT)
+    return out
+
+
 def _init_states(agg: AggCall, cols, nulls, valid) -> List:
     """Per-row initial state columns for one aggregate."""
     f = agg.function
@@ -139,19 +154,26 @@ def _init_states(agg: AggCall, cols, nulls, valid) -> List:
 
 def _merge_states(agg: AggCall, state_cols, valid) -> List:
     """Partial-state columns re-entering a (final) aggregation: states
-    combine with their own reduce kinds; invalid lanes neutralized."""
+    combine with their own reduce kinds. min/max values are neutralized
+    to their sentinel on invalid lanes AND on empty partials (count
+    state 0 — e.g. the one empty-input row a global partial emits),
+    which would otherwise contribute a bogus 0."""
     plan = _state_plan(agg)
+    count = state_cols[-1]  # every aggregate's last state is its count
     out = []
     for (kind, _dt), s in zip(plan, state_cols):
         if kind == "sum":
             z = jnp.zeros((), dtype=s.dtype)
             out.append(jnp.where(valid, s, z))
-        elif kind == "min":
-            sent = jnp.inf if s.dtype == jnp.float64 else jnp.iinfo(s.dtype).max
-            out.append(jnp.where(valid, s, jnp.asarray(sent, dtype=s.dtype)))
         else:
-            sent = -jnp.inf if s.dtype == jnp.float64 else jnp.iinfo(s.dtype).min
-            out.append(jnp.where(valid, s, jnp.asarray(sent, dtype=s.dtype)))
+            live = valid & (count > 0)
+            if kind == "min":
+                sent = jnp.inf if s.dtype == jnp.float64 \
+                    else jnp.iinfo(s.dtype).max
+            else:
+                sent = -jnp.inf if s.dtype == jnp.float64 \
+                    else jnp.iinfo(s.dtype).min
+            out.append(jnp.where(live, s, jnp.asarray(sent, dtype=s.dtype)))
     return out
 
 
@@ -347,12 +369,7 @@ class HashAggregationOperator(Operator):
         keys = [self.input_types[c] for c in self.group_channels]
         states: List[T.Type] = []
         for a in self.aggregates:
-            for (kind, dt) in _state_plan(a):
-                if kind in ("min", "max"):
-                    states.append(T.DOUBLE if a.arg_type in (T.REAL, T.DOUBLE)
-                                  else (a.arg_type or T.BIGINT))
-                else:
-                    states.append(T.DOUBLE if dt == jnp.float64 else T.BIGINT)
+            states.extend(intermediate_state_types(a.function, a.arg_type))
         return keys + states
 
     def get_output(self) -> Optional[DevicePage]:
@@ -368,6 +385,13 @@ class HashAggregationOperator(Operator):
     def _merge_partials(self) -> DevicePage:
         types = self._intermediate_types()
         nkeys = len(self.group_channels)
+        # a task that saw no input never captured key dictionaries;
+        # string outputs still need (empty) pools
+        from ..block import Dictionary
+
+        for i in range(nkeys):
+            if self._group_dicts[i] is None and types[i].is_string:
+                self._group_dicts[i] = Dictionary()
         if not self._partials:
             # no input: zero groups — except global aggregation, which
             # emits exactly one group of empty-input states (count=0,

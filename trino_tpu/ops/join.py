@@ -175,11 +175,14 @@ class HashBuilderOperator(Operator):
             valid = _pad_concat([p.valid for p in self._pages], cap)
             dicts = self._unified_dicts()
         else:
+            from ..block import Dictionary
+
             cap = 16
             cols = [jnp.zeros(cap, dtype=t.storage) for t in self.input_types]
             nulls = [jnp.ones(cap, dtype=bool) for _ in self.input_types]
             valid = jnp.zeros(cap, dtype=bool)
-            dicts = [None] * len(self.input_types)
+            dicts = [Dictionary() if t.is_string else None
+                     for t in self.input_types]
         kc = self.key_channels
         key_types = [self.input_types[c] for c in kc]
         mode = "single" if len(kc) == 1 else "hashed"

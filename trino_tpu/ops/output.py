@@ -1,0 +1,166 @@
+"""Stage-output repartitioning + exchange source operators.
+
+Reference analog: ``operator/output/PartitionedOutputOperator.java`` +
+``PagePartitioner.java`` (producer side: per-row partition assignment,
+per-partition page builders, enqueue to OutputBuffer),
+``execution/buffer/`` (PartitionedOutputBuffer / BroadcastOutputBuffer),
+and ``operator/ExchangeOperator.java`` (consumer side).
+
+TPU-first notes: partition ids are computed ON DEVICE from the same
+order-preserving uint64 normalization the join/group kernels use, so a
+hash exchange and the downstream hash join/aggregation agree on row
+routing; string keys hash via a host LUT of stable crc32 values (codes
+are pool-local, values are not). The per-partition row extraction runs
+host-side on the transferred batch — the all_to_all device collective
+path (parallel/exchange.py) replaces it when stages are co-resident on
+one mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..block import Block, Dictionary, DevicePage, Page
+from ..parallel.exchange import hash_partition_ids
+from .operator import Operator, SourceOperator
+
+
+class OutputBuffer:
+    """Thread-safe per-partition page queues for one fragment's output
+    (reference: execution/buffer/PartitionedOutputBuffer.java). With
+    ``broadcast=True`` every consumer reads all pages."""
+
+    def __init__(self, num_partitions: int, broadcast: bool = False):
+        self.num_partitions = num_partitions
+        self.broadcast = broadcast
+        self._lock = threading.Lock()
+        self._pages: List[List[Page]] = [
+            [] for _ in range(1 if broadcast else num_partitions)]
+
+    def enqueue(self, partition: int, page: Page):
+        if page.num_rows == 0:
+            return
+        with self._lock:
+            self._pages[0 if self.broadcast else partition].append(page)
+
+    def pages(self, partition: int) -> List[Page]:
+        with self._lock:
+            return list(self._pages[0 if self.broadcast else partition])
+
+    @property
+    def total_rows(self) -> int:
+        with self._lock:
+            return sum(p.num_rows for ps in self._pages for p in ps)
+
+
+def _string_hash_lut(d: Optional[Dictionary]) -> np.ndarray:
+    """code -> stable value hash (crc32), so equal strings route equally
+    regardless of which dictionary pool coded them."""
+    if d is None or len(d) == 0:
+        return np.zeros(1, dtype=np.uint64)
+    return np.asarray([zlib.crc32(("" if v is None else v).encode())
+                       for v in d.values], dtype=np.uint64)
+
+
+class PartitionedOutputOperator(Operator):
+    """Routes each row of the input to an output-buffer partition.
+    kind: 'hash' (by key columns), 'single' (partition 0), 'broadcast'.
+    """
+
+    def __init__(self, input_types: Sequence[T.Type],
+                 key_channels: Sequence[int], buffer: OutputBuffer,
+                 kind: str = "hash"):
+        assert kind in ("hash", "single", "broadcast")
+        self.input_types = list(input_types)
+        self.key_channels = list(key_channels)
+        self.buffer = buffer
+        self.kind = kind
+        self._done = False
+        self._lut_cache: Dict[tuple, np.ndarray] = {}
+
+    def add_input(self, page: DevicePage):
+        n = self.buffer.num_partitions
+        if self.kind != "hash" or n == 1:
+            host = page.to_page()
+            self.buffer.enqueue(0, host)
+            return
+        keys_u64 = []
+        for c in self.key_channels:
+            t = page.types[c]
+            raw, nulls = page.cols[c], page.nulls[c]
+            if t.is_string:
+                d = page.dictionaries[c]
+                key = (id(d), len(d) if d is not None else 0)
+                lut = self._lut_cache.get(key)
+                if lut is None:
+                    lut = _string_hash_lut(d)
+                    self._lut_cache[key] = lut
+                k = jnp.asarray(lut)[raw]
+            elif t in (T.DOUBLE, T.REAL):
+                # deterministic quantization (equal floats -> equal id);
+                # f64<->u64 bitcasts don't lower on the TPU x64 path
+                k = (jnp.asarray(raw, jnp.float64)
+                     * 65536.0).astype(jnp.int64).view(jnp.uint64)
+            else:
+                k = raw.astype(jnp.int64).view(jnp.uint64)
+            k = jnp.where(nulls, jnp.uint64(0), k)
+            keys_u64.append(k)
+        part = np.asarray(hash_partition_ids(keys_u64, n))
+        valid = np.asarray(page.valid)
+        cols = [np.asarray(c) for c in page.cols]
+        nulls = [np.asarray(x) for x in page.nulls]
+        for p in range(n):
+            idx = np.nonzero(valid & (part == p))[0]
+            if len(idx) == 0:
+                continue
+            blocks = []
+            for t, c, nl, d in zip(page.types, cols, nulls,
+                                   page.dictionaries):
+                bn = nl[idx]
+                blocks.append(Block(t, c[idx], bn if bn.any() else None, d))
+            self.buffer.enqueue(p, Page(blocks, len(idx)))
+
+    def get_output(self):
+        if self._finishing:
+            self._done = True
+        return None
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+class ExchangeSourceOperator(SourceOperator):
+    """Reads this task's partition of an upstream fragment's output
+    (reference: operator/ExchangeOperator.java). Pages from different
+    producer tasks may carry different dictionary pools — string columns
+    re-encode into one pool via Page.concat."""
+
+    def __init__(self, pages_thunk: Callable[[], List[Page]],
+                 types_: Sequence[T.Type]):
+        self._thunk = pages_thunk
+        self.types = list(types_)
+        self._pages: Optional[List[Page]] = None
+        self._done = False
+
+    def add_split(self, split):
+        raise AssertionError("exchange source has no splits")
+
+    def get_output(self) -> Optional[DevicePage]:
+        if self._pages is None:
+            pages = [p for p in self._thunk() if p.num_rows]
+            if pages and any(t.is_string for t in self.types):
+                pages = [Page.concat(pages)]
+            self._pages = pages
+        if self._pages:
+            return DevicePage.from_page(self._pages.pop(0))
+        self._done = True
+        return None
+
+    def is_finished(self) -> bool:
+        return self._done

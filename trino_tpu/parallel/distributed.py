@@ -1,0 +1,157 @@
+"""Distributed query execution: fragment DAG over N in-process workers.
+
+Reference analog: ``testing/trino-testing/.../DistributedQueryRunner.java``
+(N TestingTrinoServers in one JVM) driving the fragment execution of
+``execution/scheduler/PipelinedQueryScheduler.java``. Here: every
+fragment runs ``n_workers`` parallel tasks (threads — JAX releases the
+GIL during device compute); stage boundaries are OutputBuffers fed by
+PartitionedOutputOperators. Stages execute bottom-up with a barrier per
+fragment, i.e. the spooled-exchange (fault-tolerant) execution shape;
+the streaming pipelined overlap and the device-collective all_to_all
+boundary (parallel/exchange.py) layer on top of the same fragment
+contract.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional
+
+from .. import types as T
+from ..block import Page
+from ..connectors.spi import Connector
+from ..exec.local_planner import LocalExecutionPlanner, PhysicalPipeline
+from ..ops.output import OutputBuffer, PartitionedOutputOperator
+from ..planner.exchanges import add_exchanges
+from ..planner.fragmenter import PlanFragment, fragment_plan, fragments_str
+from ..planner.logical_planner import LogicalPlanner, Metadata
+from ..planner.optimizer import optimize
+from ..planner.plan import OutputNode
+from ..runner import QueryResult
+from ..sql import ast
+from ..sql.analyzer import Session
+from ..sql.parser import parse_statement
+
+
+class DistributedQueryRunner:
+    """Executes SQL over a simulated multi-worker cluster in one
+    process."""
+
+    def __init__(self, connectors: Dict[str, Connector],
+                 session: Optional[Session] = None, n_workers: int = 4,
+                 desired_splits: int = 8,
+                 broadcast_threshold: float = 50_000.0):
+        self.metadata = Metadata(connectors)
+        self.session = session or Session(
+            catalog=next(iter(connectors), None))
+        self.n_workers = n_workers
+        self.desired_splits = desired_splits
+        self.broadcast_threshold = broadcast_threshold
+
+    # ------------------------------------------------------------------
+
+    def create_fragments(self, sql_or_stmt) -> List[PlanFragment]:
+        stmt = sql_or_stmt if isinstance(sql_or_stmt, ast.Statement) \
+            else parse_statement(sql_or_stmt)
+        planner = LogicalPlanner(self.metadata, self.session)
+        root = planner.plan(stmt)
+        root = optimize(root, self.metadata, planner.allocator)
+        root = add_exchanges(root, self.metadata, planner.allocator,
+                             self.broadcast_threshold)
+        self._root = root
+        return fragment_plan(root)
+
+    def explain(self, sql: str) -> str:
+        return fragments_str(self.create_fragments(sql))
+
+    def execute(self, sql: str) -> QueryResult:
+        stmt = parse_statement(sql)
+        if not isinstance(stmt, ast.QueryStatement):
+            # non-query statements don't distribute; delegate
+            from ..runner import LocalQueryRunner
+
+            return LocalQueryRunner(self.metadata.connectors,
+                                    self.session).execute(sql)
+        fragments = self.create_fragments(stmt)
+        root: OutputNode = self._root
+        buffers: Dict[int, OutputBuffer] = {}
+        result_pages: List[Page] = []
+
+        with ThreadPoolExecutor(max_workers=self.n_workers) as pool:
+            for frag in fragments:
+                ntasks = 1 if frag.partitioning == "single" \
+                    else self.n_workers
+                if frag.output_kind == "output":
+                    collected = self._run_output_fragment(
+                        pool, frag, root, ntasks, buffers)
+                    result_pages = collected
+                else:
+                    buffers[frag.fragment_id] = self._run_fragment(
+                        pool, frag, ntasks, buffers)
+
+        rows: List[tuple] = []
+        for p in result_pages:
+            rows.extend(p.to_rows())
+        names = root.column_names
+        types_ = [s.type for s in root.outputs]
+        return QueryResult(names, types_, rows)
+
+    # ------------------------------------------------------------------
+
+    def _make_reader(self, buffers: Dict[int, OutputBuffer], task_id: int):
+        def reader(fragment_id: int, kind: str):
+            buf = buffers[fragment_id]
+            part = 0 if kind == "single" else task_id
+
+            def thunk():
+                return buf.pages(part)
+
+            return thunk
+
+        return reader
+
+    def _run_fragment(self, pool, frag: PlanFragment, ntasks: int,
+                      buffers: Dict[int, OutputBuffer]) -> OutputBuffer:
+        # consumer partition count: single -> 1, hash -> n_workers,
+        # broadcast -> replicated
+        if frag.output_kind == "single":
+            out = OutputBuffer(1)
+        elif frag.output_kind == "broadcast":
+            out = OutputBuffer(self.n_workers, broadcast=True)
+        else:
+            out = OutputBuffer(self.n_workers)
+
+        def run_task(t: int):
+            planner = LocalExecutionPlanner(
+                self.metadata, self.desired_splits, task_id=t,
+                task_count=ntasks,
+                exchange_reader=self._make_reader(buffers, t))
+            ops, layout, types_ = planner.visit(frag.root)
+            key_channels = [layout[s.name] for s in frag.output_keys]
+            ops.append(PartitionedOutputOperator(
+                types_, key_channels, out, frag.output_kind))
+            planner.pipelines.append(PhysicalPipeline(ops))
+            from ..exec.driver import Driver
+
+            for p in planner.pipelines:
+                Driver(p.operators).run_to_completion()
+
+        list(pool.map(run_task, range(ntasks)))
+        return out
+
+    def _run_output_fragment(self, pool, frag: PlanFragment,
+                             root: OutputNode, ntasks: int,
+                             buffers) -> List[Page]:
+        results: List[List[Page]] = [[] for _ in range(ntasks)]
+
+        def run_task(t: int):
+            planner = LocalExecutionPlanner(
+                self.metadata, self.desired_splits, task_id=t,
+                task_count=ntasks,
+                exchange_reader=self._make_reader(buffers, t))
+            plan = planner.plan(OutputNode(frag.root, root.column_names,
+                                           root.outputs))
+            results[t] = plan.execute()
+
+        list(pool.map(run_task, range(ntasks)))
+        return [p for r in results for p in r]

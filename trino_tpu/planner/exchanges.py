@@ -1,0 +1,223 @@
+"""AddExchanges: insert stage boundaries into an optimized plan.
+
+Reference analog: ``sql/planner/optimizations/AddExchanges.java`` (global
+property matching: required vs delivered distribution) plus the
+partial-aggregation split from ``PushPartialAggregationThroughExchange``.
+Property model compressed to the cases the engine executes:
+
+- 'source'   — partitioned arbitrarily by table splits
+- ('hash', keys) — rows partitioned on the hash of ``keys``
+- 'single'   — everything in one task
+- 'any'      — single-row / values
+
+Aggregations split into partial (runs in the producer distribution) →
+hash/single exchange → final. Joins choose broadcast (small build) vs
+partitioned (both sides exchanged on the join keys) by estimated size —
+the reference's cost-based distribution choice with size-greedy
+estimates. Sort/TopN/Limit gain partial→gather→final phases.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from .. import types as T
+from ..ops.aggregation import intermediate_state_types
+from .logical_planner import Metadata
+from .optimizer import Optimizer
+from .plan import (AggregationNode, CrossJoinNode, DistinctNode,
+                   EnforceSingleRowNode, ExceptNode, ExchangeNode,
+                   FilterNode, IntersectNode, JoinNode, LimitNode,
+                   OutputNode, PlanNode, ProjectNode, SortNode,
+                   TableScanNode, TopNNode, UnionNode, ValuesNode)
+from .symbols import Symbol, SymbolAllocator
+
+BROADCAST_THRESHOLD = 50_000.0
+
+SINGLE = ("single",)
+SOURCE = ("source",)
+ANY = ("any",)
+
+
+def _hash(keys: List[Symbol]):
+    return ("hash", tuple(s.name for s in keys))
+
+
+def state_types_for(agg: "Aggregation") -> List[T.Type]:  # noqa: F821
+    """Intermediate state column types of one plan-level aggregate."""
+    arg_type = agg.argument.type if agg.argument is not None else None
+    return intermediate_state_types(agg.function, arg_type)
+
+
+class ExchangePlanner:
+    def __init__(self, metadata: Metadata, allocator: SymbolAllocator,
+                 broadcast_threshold: float = BROADCAST_THRESHOLD):
+        self.metadata = metadata
+        self.allocator = allocator
+        self.broadcast_threshold = broadcast_threshold
+        self._est = Optimizer(metadata, allocator)
+
+    def run(self, root: OutputNode) -> OutputNode:
+        node, dist = self.visit(root.source)
+        node = self._to_single(node, dist)
+        return OutputNode(node, root.column_names, root.outputs)
+
+    # ------------------------------------------------------------------
+
+    def _to_single(self, node: PlanNode, dist) -> PlanNode:
+        if dist in (SINGLE, ANY):
+            return node
+        return ExchangeNode(node, "single", [])
+
+    def visit(self, node: PlanNode) -> Tuple[PlanNode, tuple]:
+        m = getattr(self, "_v_" + type(node).__name__, None)
+        if m is not None:
+            return m(node)
+        # default: force children single, keep node single
+        new_sources = [self._to_single(*self.visit(s))
+                       for s in node.sources]
+        from .optimizer import _replace_sources
+
+        return _replace_sources(node, new_sources), SINGLE
+
+    def _v_TableScanNode(self, node):
+        return node, SOURCE
+
+    def _v_ValuesNode(self, node):
+        return node, ANY
+
+    def _v_FilterNode(self, node):
+        src, dist = self.visit(node.source)
+        return FilterNode(src, node.predicate), dist
+
+    def _v_ProjectNode(self, node):
+        src, dist = self.visit(node.source)
+        # a projection may drop the symbols the distribution names;
+        # degrade to 'any-partitioned' (still parallel) in that case
+        if dist[0] == "hash":
+            out_names = {s.name for s, _ in node.assignments}
+            if not set(dist[1]) <= out_names:
+                dist = SOURCE
+        return ProjectNode(src, node.assignments), dist
+
+    def _v_EnforceSingleRowNode(self, node):
+        src, dist = self.visit(node.source)
+        return EnforceSingleRowNode(self._to_single(src, dist)), SINGLE
+
+    def _v_AggregationNode(self, node: AggregationNode):
+        src, dist = self.visit(node.source)
+        keys = node.group_keys
+        if dist in (SINGLE, ANY):
+            return AggregationNode(src, keys, node.aggregations,
+                                   node.step), dist
+        if keys and dist == _hash(keys):
+            # already partitioned on the grouping keys: aggregate locally
+            return AggregationNode(src, keys, node.aggregations,
+                                   node.step), dist
+        # partial -> exchange -> final
+        state_symbols: List[Symbol] = []
+        for out_sym, agg in node.aggregations:
+            for j, st in enumerate(state_types_for(agg)):
+                state_symbols.append(self.allocator.new_symbol(
+                    f"{out_sym.name}_st{j}", st))
+        partial = AggregationNode(src, keys, node.aggregations, "partial",
+                                  state_symbols)
+        if keys:
+            ex = ExchangeNode(partial, "hash", list(keys))
+            final_dist = _hash(keys)
+        else:
+            ex = ExchangeNode(partial, "single", [])
+            final_dist = SINGLE
+        final = AggregationNode(ex, keys, node.aggregations, "final",
+                                state_symbols)
+        return final, final_dist
+
+    def _v_DistinctNode(self, node: DistinctNode):
+        src, dist = self.visit(node.source)
+        if dist in (SINGLE, ANY):
+            return DistinctNode(src), dist
+        cols = src.output_symbols
+        if dist == _hash(cols):
+            return DistinctNode(src), dist
+        # local distinct -> hash exchange on all columns -> final distinct
+        local = DistinctNode(src)
+        ex = ExchangeNode(local, "hash", list(cols))
+        return DistinctNode(ex), _hash(cols)
+
+    def _v_JoinNode(self, node: JoinNode):
+        left, ldist = self.visit(node.left)
+        right, rdist = self.visit(node.right)
+        lkeys = [l for l, _ in node.criteria]
+        rkeys = [r for _, r in node.criteria]
+
+        right_rows = self._est._base_rows(node.right)
+        partitioned = (right_rows > self.broadcast_threshold
+                       and bool(node.criteria)
+                       and ldist not in (SINGLE, ANY))
+        if partitioned:
+            if ldist != _hash(lkeys):
+                left = ExchangeNode(left, "hash", lkeys)
+            if rdist != _hash(rkeys):
+                right = ExchangeNode(right, "hash", rkeys)
+            out_dist = _hash(lkeys)
+        else:
+            # broadcast (or probe is single anyway): build side
+            # replicated to every probe task
+            if ldist in (SINGLE, ANY):
+                right = self._to_single(right, rdist)
+            else:
+                right = ExchangeNode(right, "broadcast", [])
+            out_dist = ldist
+        return JoinNode(node.join_type, left, right, node.criteria,
+                        node.filter_expr), out_dist
+
+    def _v_CrossJoinNode(self, node: CrossJoinNode):
+        left, ldist = self.visit(node.left)
+        right, rdist = self.visit(node.right)
+        if ldist not in (SINGLE, ANY):
+            right = ExchangeNode(right, "broadcast", [])
+        else:
+            right = self._to_single(right, rdist)
+        return CrossJoinNode(left, right), ldist
+
+    def _v_TopNNode(self, node: TopNNode):
+        src, dist = self.visit(node.source)
+        if dist in (SINGLE, ANY):
+            return TopNNode(src, node.orderings, node.count), dist
+        partial = TopNNode(src, node.orderings, node.count)
+        ex = ExchangeNode(partial, "single", [])
+        return TopNNode(ex, node.orderings, node.count), SINGLE
+
+    def _v_SortNode(self, node: SortNode):
+        src, dist = self.visit(node.source)
+        return SortNode(self._to_single(src, dist), node.orderings), SINGLE
+
+    def _v_LimitNode(self, node: LimitNode):
+        src, dist = self.visit(node.source)
+        if dist in (SINGLE, ANY):
+            return LimitNode(src, node.count, node.offset), dist
+        if node.count is not None:
+            # per-task pre-limit (count+offset rows suffice), then final
+            src = LimitNode(src, node.count + node.offset, 0)
+        ex = ExchangeNode(src, "single", [])
+        return LimitNode(ex, node.count, node.offset), SINGLE
+
+    def _v_UnionNode(self, node: UnionNode):
+        inputs = [self._to_single(*self.visit(s)) for s in node.inputs]
+        return UnionNode(node.symbols, inputs), SINGLE
+
+    def _v_IntersectNode(self, node: IntersectNode):
+        inputs = [self._to_single(*self.visit(s)) for s in node.inputs]
+        return IntersectNode(node.symbols, inputs), SINGLE
+
+    def _v_ExceptNode(self, node: ExceptNode):
+        inputs = [self._to_single(*self.visit(s)) for s in node.inputs]
+        return ExceptNode(node.symbols, inputs), SINGLE
+
+
+def add_exchanges(root: OutputNode, metadata: Metadata,
+                  allocator: SymbolAllocator,
+                  broadcast_threshold: float = BROADCAST_THRESHOLD
+                  ) -> OutputNode:
+    return ExchangePlanner(metadata, allocator,
+                           broadcast_threshold).run(root)

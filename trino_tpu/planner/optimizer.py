@@ -388,7 +388,8 @@ def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
         return ProjectNode(sources[0], node.assignments)
     if isinstance(node, AggregationNode):
         return AggregationNode(sources[0], node.group_keys,
-                               node.aggregations, node.step)
+                               node.aggregations, node.step,
+                               node.state_symbols)
     if isinstance(node, JoinNode):
         return JoinNode(node.join_type, sources[0], sources[1],
                         node.criteria, node.filter_expr)
@@ -412,6 +413,10 @@ def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
         return ExceptNode(node.symbols, sources)
     if isinstance(node, OutputNode):
         return OutputNode(sources[0], node.column_names, node.outputs)
-    if isinstance(node, (TableScanNode, ValuesNode)):
+    from .plan import ExchangeNode, RemoteSourceNode
+
+    if isinstance(node, ExchangeNode):
+        return ExchangeNode(sources[0], node.kind, node.keys)
+    if isinstance(node, (TableScanNode, ValuesNode, RemoteSourceNode)):
         return node
     raise AssertionError(f"unknown node {type(node).__name__}")

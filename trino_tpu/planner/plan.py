@@ -105,12 +105,15 @@ class Aggregation:
 
 @dataclass
 class AggregationNode(PlanNode):
-    """Reference: sql/planner/plan/AggregationNode.java"""
+    """Reference: sql/planner/plan/AggregationNode.java. For
+    ``step='partial'`` the outputs are keys + ``state_symbols`` (one per
+    accumulator state column, set by the exchange planner)."""
 
     source: PlanNode
     group_keys: List[Symbol]
     aggregations: List[Tuple[Symbol, Aggregation]]
     step: str = "single"  # single | partial | final
+    state_symbols: Optional[List[Symbol]] = None
 
     @property
     def sources(self):
@@ -118,6 +121,8 @@ class AggregationNode(PlanNode):
 
     @property
     def output_symbols(self):
+        if self.step == "partial":
+            return list(self.group_keys) + list(self.state_symbols or [])
         return list(self.group_keys) + [s for s, _ in self.aggregations]
 
 
@@ -301,6 +306,40 @@ class EnforceSingleRowNode(PlanNode):
     @property
     def output_symbols(self):
         return self.source.output_symbols
+
+
+@dataclass
+class ExchangeNode(PlanNode):
+    """A stage boundary (reference: sql/planner/plan/ExchangeNode.java,
+    scope=REMOTE). ``kind``: 'hash' (partition rows on ``keys``),
+    'single' (gather to one task), 'broadcast' (replicate to every
+    consumer task)."""
+
+    source: PlanNode
+    kind: str
+    keys: List[Symbol]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols
+
+
+@dataclass
+class RemoteSourceNode(PlanNode):
+    """Reads one fragment's exchange output inside a consumer fragment
+    (reference: sql/planner/plan/RemoteSourceNode.java)."""
+
+    fragment_id: int
+    symbols: List[Symbol]
+    kind: str  # of the originating exchange
+
+    @property
+    def output_symbols(self):
+        return list(self.symbols)
 
 
 @dataclass
