@@ -1,0 +1,108 @@
+"""Window function tests (reference analog: AbstractTestWindowQueries)."""
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=4096)},
+                            Session(catalog="tpch", schema="micro"))
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+def test_row_number(runner):
+    rows = q(runner, """
+        select n_name, row_number() over (partition by n_regionkey
+                                          order by n_name) rn
+        from nation where n_regionkey = 1 order by rn""")
+    assert [r[1] for r in rows] == [1, 2, 3, 4, 5]
+    assert rows[0][0] < rows[1][0]
+
+
+def test_rank_dense_rank_with_ties():
+    from trino_tpu.block import Page
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu import types as T
+
+    mem = MemoryConnector()
+    r = LocalQueryRunner({"memory": mem},
+                         Session(catalog="memory", schema="default"))
+    r.execute("create table t (g bigint, v bigint)")
+    r.execute("insert into t values (1, 10), (1, 10), (1, 20), "
+              "(2, 5), (2, 6), (2, 6), (2, 7)")
+    rows = q(r, """
+        select g, v, rank() over (partition by g order by v) rk,
+               dense_rank() over (partition by g order by v) dr
+        from t order by g, v""")
+    assert rows == [(1, 10, 1, 1), (1, 10, 1, 1), (1, 20, 3, 2),
+                    (2, 5, 1, 1), (2, 6, 2, 2), (2, 6, 2, 2),
+                    (2, 7, 4, 3)]
+    # running sum: RANGE default includes peers
+    rows = q(r, """
+        select g, v, sum(v) over (partition by g order by v) s
+        from t order by g, v""")
+    assert rows == [(1, 10, 20), (1, 10, 20), (1, 20, 40),
+                    (2, 5, 5), (2, 6, 17), (2, 6, 17), (2, 7, 24)]
+    # ROWS frame: exact per-row prefix
+    rows = q(r, """
+        select g, v, sum(v) over (partition by g order by v
+            rows unbounded preceding) s
+        from t order by g, v, s""")
+    assert rows == [(1, 10, 10), (1, 10, 20), (1, 20, 40),
+                    (2, 5, 5), (2, 6, 11), (2, 6, 17), (2, 7, 24)]
+
+
+def test_partition_total_and_avg(runner):
+    rows = q(runner, """
+        select distinct n_regionkey,
+               count(*) over (partition by n_regionkey) c
+        from nation order by n_regionkey""")
+    assert rows == [(0, 5), (1, 5), (2, 5), (3, 5), (4, 5)]
+
+
+def test_lag_lead(runner):
+    rows = q(runner, """
+        select n_nationkey,
+               lag(n_nationkey) over (order by n_nationkey) lg,
+               lead(n_nationkey, 2) over (order by n_nationkey) ld
+        from nation order by n_nationkey limit 4""")
+    assert rows == [(0, None, 2), (1, 0, 3), (2, 1, 4), (3, 2, 5)]
+
+
+def test_first_value_and_ntile(runner):
+    rows = q(runner, """
+        select n_nationkey,
+               first_value(n_name) over (partition by n_regionkey
+                                         order by n_nationkey) fv,
+               ntile(2) over (order by n_nationkey) nt
+        from nation order by n_nationkey""")
+    assert rows[0][2] == 1 and rows[-1][2] == 2
+    assert isinstance(rows[0][1], str)
+
+
+def test_window_over_aggregate(runner):
+    rows = q(runner, """
+        select n_regionkey, count(*) c,
+               sum(count(*)) over () total
+        from nation group by n_regionkey order by n_regionkey""")
+    assert all(r[2] == 25 for r in rows)
+    assert sum(r[1] for r in rows) == 25
+
+
+def test_window_in_subquery_topn_pattern(runner):
+    # the classic top-n-per-group pattern
+    rows = q(runner, """
+        select n_regionkey, n_name from (
+            select n_regionkey, n_name,
+                   row_number() over (partition by n_regionkey
+                                      order by n_name) rn
+            from nation) t
+        where rn = 1 order by n_regionkey""")
+    assert len(rows) == 5

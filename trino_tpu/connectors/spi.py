@@ -100,6 +100,17 @@ class ConnectorMetadata:
     def get_statistics(self, table: TableHandle) -> TableStatistics:
         return TableStatistics()
 
+    # -- DDL (reference: ConnectorMetadata createTable/dropTable) ------
+
+    def create_table(self, schema: str, table: str,
+                     columns: List[ColumnHandle]) -> TableHandle:
+        raise T.TrinoError("connector does not support CREATE TABLE",
+                           "NOT_SUPPORTED")
+
+    def drop_table(self, table: TableHandle):
+        raise T.TrinoError("connector does not support DROP TABLE",
+                           "NOT_SUPPORTED")
+
 
 class ConnectorSplitManager:
     """Split enumeration (reference: spi/connector/ConnectorSplitManager)."""

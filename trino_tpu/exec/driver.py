@@ -8,18 +8,40 @@ Synchronous for now; the task executor adds cooperative quanta on top
 
 from __future__ import annotations
 
+import time
+from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
 from ..connectors.spi import ConnectorSplit
 from ..ops.operator import Operator, SourceOperator
 
 
+@dataclass
+class OperatorStats:
+    """Per-operator execution stats (reference:
+    operator/OperatorStats.java — wall/cpu nanos, rows/pages in+out)."""
+
+    name: str
+    output_rows: int = 0
+    output_pages: int = 0
+    wall_ns: int = 0
+
+    def line(self) -> str:
+        ms = self.wall_ns / 1e6
+        return (f"{self.name}: {self.output_rows} rows, "
+                f"{self.output_pages} pages, {ms:.1f}ms")
+
+
 class Driver:
     """Executes one operator chain to completion."""
 
-    def __init__(self, operators: Sequence[Operator]):
+    def __init__(self, operators: Sequence[Operator],
+                 collect_stats: bool = False):
         assert operators, "empty pipeline"
         self.operators: List[Operator] = list(operators)
+        self.collect_stats = collect_stats
+        self.stats: List[OperatorStats] = [
+            OperatorStats(type(op).__name__) for op in operators]
 
     @property
     def source(self) -> Optional[SourceOperator]:
@@ -47,9 +69,24 @@ class Driver:
             if cur.is_finished() and not nxt._finishing:
                 nxt.finish()
             if nxt.needs_input():
-                page = cur.get_output()
+                if self.collect_stats:
+                    t0 = time.perf_counter_ns()
+                    page = cur.get_output()
+                    st = self.stats[i]
+                    st.wall_ns += time.perf_counter_ns() - t0
+                    if page is not None:
+                        st.output_pages += 1
+                        st.output_rows += page.count()
+                else:
+                    page = cur.get_output()
                 if page is not None:
-                    nxt.add_input(page)
+                    if self.collect_stats:
+                        t0 = time.perf_counter_ns()
+                        nxt.add_input(page)
+                        self.stats[i + 1].wall_ns += \
+                            time.perf_counter_ns() - t0
+                    else:
+                        nxt.add_input(page)
                     moved = True
         # drain the tail operator (sinks produce no output)
         ops[-1].get_output()

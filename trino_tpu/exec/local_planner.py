@@ -53,11 +53,14 @@ class LocalExecutionPlan:
         self.column_names = column_names
         self.output_types = output_types
 
-    def execute(self) -> List[Page]:
+    def execute(self, collect_stats: bool = False) -> List[Page]:
         from .driver import Driver
 
+        self.drivers = []
         for p in self.pipelines:
-            Driver(p.operators).run_to_completion()
+            d = Driver(p.operators, collect_stats=collect_stats)
+            self.drivers.append(d)
+            d.run_to_completion()
         return self.sink.pages
 
 
@@ -331,6 +334,45 @@ class LocalExecutionPlanner:
         source = DeferredPagesSourceOperator(union_pages)
         layout = {s.name: i for i, s in enumerate(node.symbols)}
         return [source], layout, [s.type for s in node.symbols]
+
+    def _v_WindowNode(self, node):
+        from ..ops.window import WindowCall, WindowOperator
+
+        ops, layout, types_ = self.visit(node.source)
+        pchans = [layout[s.name] for s in node.partition_by]
+        keys = _sort_keys(node.orderings, layout)
+        calls = []
+        for out_sym, f in node.functions:
+            arg_ch = layout[f.argument.name] if f.argument is not None \
+                else None
+            calls.append(WindowCall(
+                f.function, arg_ch,
+                f.argument.type if f.argument is not None else None,
+                out_sym.type, f.frame_mode, f.offset))
+        ops.append(WindowOperator(types_, pchans, keys, calls))
+        new_layout = dict(layout)
+        out_types = list(types_)
+        for j, (out_sym, _f) in enumerate(node.functions):
+            new_layout[out_sym.name] = len(types_) + j
+            out_types.append(out_sym.type)
+        return ops, new_layout, out_types
+
+    def _v_TableWriterNode(self, node):
+        from ..ops.operator import TableWriterOperator
+
+        ops, layout, types_ = self.visit(node.source)
+        conn = self.metadata.connectors[node.catalog]
+        if node.create:
+            # CTAS creates the target here, at execution time — EXPLAIN
+            # and failed planning never mutate metadata
+            handle = conn.metadata().create_table(
+                node.schema, node.table_name, node.columns)
+        else:
+            handle = conn.metadata().get_table_handle(node.schema,
+                                                      node.table_name)
+        sink = conn.page_sink(handle, node.columns)
+        ops.append(TableWriterOperator(sink))
+        return ops, {node.rows_symbol.name: 0}, [T.BIGINT]
 
     def _v_RemoteSourceNode(self, node):
         assert self.exchange_reader is not None, \

@@ -300,6 +300,38 @@ class DeferredPagesSourceOperator(SourceOperator):
         return self._done
 
 
+class TableWriterOperator(Operator):
+    """Feeds pages to a ConnectorPageSink; at finish emits one row with
+    the written count (reference: operator/TableWriterOperator.java +
+    TableFinishOperator.java — commit folded into sink.finish())."""
+
+    def __init__(self, sink):
+        self.sink = sink
+        self.rows = 0
+        self._emitted = False
+        self._done = False
+
+    def add_input(self, page: DevicePage):
+        host = page.to_page()
+        if host.num_rows:
+            self.rows += host.num_rows
+            self.sink.append_page(host)
+
+    def get_output(self) -> Optional[DevicePage]:
+        if not self._finishing or self._emitted:
+            return None
+        self._emitted = True
+        self._done = True
+        self.sink.finish()
+        from .. import types as T
+
+        return DevicePage.from_page(
+            Page.from_pylists([T.BIGINT], [[self.rows]]))
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
 class OutputCollectorOperator(Operator):
     """Pipeline sink: densifies device pages back to host Pages
     (reference analog: TaskOutputOperator feeding the OutputBuffer)."""

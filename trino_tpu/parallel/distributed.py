@@ -38,15 +38,21 @@ class DistributedQueryRunner:
     process."""
 
     def __init__(self, connectors: Dict[str, Connector],
-                 session: Optional[Session] = None, n_workers: int = 4,
+                 session: Optional[Session] = None,
+                 n_workers: Optional[int] = None,
                  desired_splits: int = 8,
-                 broadcast_threshold: float = 50_000.0):
+                 broadcast_threshold: Optional[float] = None):
+        from .. import session_properties as SP
+
         self.metadata = Metadata(connectors)
         self.session = session or Session(
             catalog=next(iter(connectors), None))
-        self.n_workers = n_workers
+        self.n_workers = n_workers if n_workers is not None \
+            else SP.value(self.session, "task_concurrency")
         self.desired_splits = desired_splits
-        self.broadcast_threshold = broadcast_threshold
+        self.broadcast_threshold = broadcast_threshold \
+            if broadcast_threshold is not None \
+            else SP.value(self.session, "broadcast_join_threshold")
 
     # ------------------------------------------------------------------
 
@@ -55,9 +61,13 @@ class DistributedQueryRunner:
             else parse_statement(sql_or_stmt)
         planner = LogicalPlanner(self.metadata, self.session)
         root = planner.plan(stmt)
+        from .. import session_properties as SP
+
         root = optimize(root, self.metadata, planner.allocator)
-        root = add_exchanges(root, self.metadata, planner.allocator,
-                             self.broadcast_threshold)
+        root = add_exchanges(
+            root, self.metadata, planner.allocator,
+            self.broadcast_threshold,
+            SP.value(self.session, "join_distribution_type"))
         self._root = root
         return fragment_plan(root)
 

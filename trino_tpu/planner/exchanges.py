@@ -51,10 +51,12 @@ def state_types_for(agg: "Aggregation") -> List[T.Type]:  # noqa: F821
 
 class ExchangePlanner:
     def __init__(self, metadata: Metadata, allocator: SymbolAllocator,
-                 broadcast_threshold: float = BROADCAST_THRESHOLD):
+                 broadcast_threshold: float = BROADCAST_THRESHOLD,
+                 join_distribution: str = "AUTOMATIC"):
         self.metadata = metadata
         self.allocator = allocator
         self.broadcast_threshold = broadcast_threshold
+        self.join_distribution = join_distribution
         self._est = Optimizer(metadata, allocator)
 
     def run(self, root: OutputNode) -> OutputNode:
@@ -151,9 +153,14 @@ class ExchangePlanner:
         rkeys = [r for _, r in node.criteria]
 
         right_rows = self._est._base_rows(node.right)
-        partitioned = (right_rows > self.broadcast_threshold
-                       and bool(node.criteria)
-                       and ldist not in (SINGLE, ANY))
+        if self.join_distribution == "BROADCAST":
+            partitioned = False
+        elif self.join_distribution == "PARTITIONED":
+            partitioned = bool(node.criteria) and ldist not in (SINGLE, ANY)
+        else:
+            partitioned = (right_rows > self.broadcast_threshold
+                           and bool(node.criteria)
+                           and ldist not in (SINGLE, ANY))
         if partitioned:
             if ldist != _hash(lkeys):
                 left = ExchangeNode(left, "hash", lkeys)
@@ -179,6 +186,19 @@ class ExchangePlanner:
         else:
             right = self._to_single(right, rdist)
         return CrossJoinNode(left, right), ldist
+
+    def _v_WindowNode(self, node):
+        from .plan import WindowNode
+
+        src, dist = self.visit(node.source)
+        if not node.partition_by:
+            src, dist = self._to_single(src, dist), SINGLE
+        elif dist not in (SINGLE, ANY) and \
+                dist != _hash(node.partition_by):
+            src = ExchangeNode(src, "hash", list(node.partition_by))
+            dist = _hash(node.partition_by)
+        return WindowNode(src, node.partition_by, node.orderings,
+                          node.functions), dist
 
     def _v_TopNNode(self, node: TopNNode):
         src, dist = self.visit(node.source)
@@ -217,7 +237,7 @@ class ExchangePlanner:
 
 def add_exchanges(root: OutputNode, metadata: Metadata,
                   allocator: SymbolAllocator,
-                  broadcast_threshold: float = BROADCAST_THRESHOLD
-                  ) -> OutputNode:
-    return ExchangePlanner(metadata, allocator,
-                           broadcast_threshold).run(root)
+                  broadcast_threshold: float = BROADCAST_THRESHOLD,
+                  join_distribution: str = "AUTOMATIC") -> OutputNode:
+    return ExchangePlanner(metadata, allocator, broadcast_threshold,
+                           join_distribution).run(root)

@@ -18,7 +18,7 @@ from ..sql import ast
 from ..sql.analyzer import (AGGREGATE_FUNCTIONS, AnalysisError,
                             ExpressionAnalyzer, FieldDef, Scope, Session,
                             coerce, common_type, expression_uses_scope,
-                            find_aggregates)
+                            find_aggregates, find_windows)
 from .plan import (Aggregation, AggregationNode, CrossJoinNode, DistinctNode,
                    EnforceSingleRowNode, ExceptNode, FilterNode,
                    IntersectNode, JoinNode, LimitNode, Ordering, OutputNode,
@@ -88,6 +88,24 @@ class Metadata:
                     handle)
         return None
 
+    def resolve_target(self, name: Tuple[str, ...], session: Session):
+        """DDL/write target resolution (shared by planner and runner):
+        (catalog, connector, schema, table)."""
+        parts = tuple(p.lower() for p in name)
+        if len(parts) == 3:
+            catalog, schema, table = parts
+        elif len(parts) == 2:
+            catalog, (schema, table) = session.catalog, parts
+        else:
+            catalog, schema, table = (session.catalog, session.schema,
+                                      parts[0])
+        conn = self.connectors.get(catalog)
+        if conn is None:
+            from ..sql.analyzer import AnalysisError
+
+            raise AnalysisError(f"catalog '{catalog}' does not exist")
+        return catalog, conn, schema, table
+
 
 class LogicalPlanner:
     """Reference: sql/planner/LogicalPlanner.java."""
@@ -105,8 +123,82 @@ class LogicalPlanner:
                      for i, f in enumerate(rp.scope.visible_fields())]
             outputs = [f.symbol for f in rp.scope.visible_fields()]
             return OutputNode(rp.node, names, outputs)
+        if isinstance(stmt, ast.CreateTableAsSelect):
+            return self.plan_ctas(stmt)
+        if isinstance(stmt, ast.Insert):
+            return self.plan_insert(stmt)
         raise AnalysisError(
             f"unsupported statement: {type(stmt).__name__}")
+
+    def _target(self, name):
+        """(catalog, connector, schema, table) for a DDL/write target."""
+        return self.metadata.resolve_target(name, self.session)
+
+    def plan_ctas(self, stmt: ast.CreateTableAsSelect) -> OutputNode:
+        from ..connectors.spi import ColumnHandle
+        from .plan import TableWriterNode
+
+        catalog, conn, schema, table = self._target(stmt.name)
+        exists = conn.metadata().get_table_handle(schema, table) is not None
+        if exists:
+            if stmt.if_not_exists:
+                zero = self.allocator.new_symbol("rows", T.BIGINT)
+                return OutputNode(
+                    ValuesNode([zero], [[Literal(T.BIGINT, 0)]]),
+                    ["rows"], [zero])
+            raise AnalysisError(
+                f"Table '{schema}.{table}' already exists")
+        planner = QueryPlanner(self, {})
+        rp = planner.plan_query(stmt.query, outer_scope=None)
+        vis = rp.scope.visible_fields()
+        columns = [ColumnHandle(f.name or f"_col{i}", f.symbol.type, i)
+                   for i, f in enumerate(vis)]
+        proj = ProjectNode(rp.node, [(f.symbol, f.symbol.ref())
+                                     for f in vis])
+        rows = self.allocator.new_symbol("rows", T.BIGINT)
+        writer = TableWriterNode(proj, catalog, schema, table, columns,
+                                 rows, create=True)
+        return OutputNode(writer, ["rows"], [rows])
+
+    def plan_insert(self, stmt: ast.Insert) -> OutputNode:
+        from .plan import TableWriterNode
+
+        catalog, conn, schema, table = self._target(stmt.table)
+        handle = conn.metadata().get_table_handle(schema, table)
+        if handle is None:
+            raise AnalysisError(
+                f"table '{schema}.{table}' does not exist")
+        target_cols = conn.metadata().get_columns(handle)
+        planner = QueryPlanner(self, {})
+        rp = planner.plan_query(stmt.query, outer_scope=None)
+        vis = rp.scope.visible_fields()
+        if stmt.columns:
+            by_name = {c.name.lower(): c for c in target_cols}
+            specified = []
+            for cn in stmt.columns:
+                c = by_name.get(cn.lower())
+                if c is None:
+                    raise AnalysisError(f"column '{cn}' does not exist")
+                specified.append(c)
+        else:
+            specified = list(target_cols)
+        if len(vis) != len(specified):
+            raise AnalysisError(
+                f"INSERT has {len(vis)} columns but table expects "
+                f"{len(specified)}")
+        # write in TABLE column order; unspecified columns get NULL
+        value_of = {c.name: coerce(f.symbol.ref(), c.type)
+                    for c, f in zip(specified, vis)}
+        assignments = []
+        for c in target_cols:
+            expr = value_of.get(c.name, Literal(c.type, None))
+            sym = self.allocator.new_symbol(c.name, c.type)
+            assignments.append((sym, expr))
+        proj = ProjectNode(rp.node, assignments)
+        rows = self.allocator.new_symbol("rows", T.BIGINT)
+        writer = TableWriterNode(proj, catalog, schema, table,
+                                 target_cols, rows)
+        return OutputNode(writer, ["rows"], [rows])
 
 
 class RelationPlan:
@@ -371,6 +463,17 @@ class QueryPlanner:
             rp = RelationPlan(FilterNode(having_state.rp.node, pred),
                               having_state.rp.scope)
 
+        # window functions (evaluate over post-aggregation rows)
+        window_calls: List[ast.FunctionCall] = []
+        for e, _, _f in select_exprs:
+            if e is not None:
+                window_calls.extend(find_windows(e))
+        for si in spec.order_by:
+            window_calls.extend(find_windows(si.key))
+        if window_calls:
+            rp, replacements = self.plan_windows(rp, window_calls,
+                                                 replacements)
+
         # SELECT projections
         hook_state = _HookState(rp)
         analyzer = ExpressionAnalyzer(
@@ -556,6 +659,126 @@ class QueryPlanner:
                             and e is not None:
                         return analyzer.analyze(e), e
             raise
+
+    # ------------------------------------------------------------------
+    # window functions
+
+    def plan_windows(self, rp: RelationPlan,
+                     calls: List[ast.FunctionCall],
+                     replacements: Dict) -> Tuple[RelationPlan, Dict]:
+        """One WindowNode per distinct (partition, order, frame) spec
+        (reference: QueryPlanner window planning +
+        plan/WindowNode.java)."""
+        from ..ops.window import (AGG_FNS, RANKING, VALUE_FNS,
+                                  resolve_window_type)
+        from .plan import WindowFunctionSpec, WindowNode
+
+        replacements = dict(replacements)
+        by_spec: Dict[ast.Window, List[ast.FunctionCall]] = {}
+        for c in calls:
+            by_spec.setdefault(c.window, []).append(c)
+
+        for window, group in by_spec.items():
+            analyzer = ExpressionAnalyzer(rp.scope, self.ctx.session,
+                                          replacements=replacements)
+            node = rp.node
+            pre: List[Tuple[Symbol, RowExpression]] = [
+                (s, s.ref()) for s in node.output_symbols]
+            pre_index: Dict[RowExpression, Symbol] = {}
+
+            def channel_for(expr, hint):
+                if isinstance(expr, SymbolRef) and any(
+                        s.name == expr.name for s, _ in pre):
+                    return Symbol(expr.name, expr.type)
+                got = pre_index.get(expr)
+                if got is not None:
+                    return got
+                sym = self.allocator.new_symbol(hint, expr.type)
+                pre.append((sym, expr))
+                pre_index[expr] = sym
+                return sym
+
+            partition_by = [channel_for(analyzer.analyze(p), "wpart")
+                            for p in window.partition_by]
+            orderings = []
+            for si in window.order_by:
+                sym = channel_for(analyzer.analyze(si.key), "worder")
+                orderings.append(Ordering(sym, si.ascending,
+                                          si.nulls_last))
+            frame_mode = self._frame_mode(window)
+            functions: List[Tuple[Symbol, "WindowFunctionSpec"]] = []
+            for c in group:
+                name = c.name.lower()
+                if c.distinct:
+                    raise AnalysisError(
+                        "DISTINCT window aggregates not supported")
+                arg_sym = None
+                offset = 1
+                if name == "count" and not c.args:
+                    name = "count_star"
+                elif name == "ntile":
+                    if len(c.args) != 1 or not isinstance(
+                            c.args[0], ast.LongLiteral):
+                        raise AnalysisError(
+                            "ntile requires a literal bucket count")
+                    offset = c.args[0].value
+                elif name in ("lag", "lead"):
+                    if not (1 <= len(c.args) <= 2):
+                        raise AnalysisError(
+                            f"{name} takes 1-2 arguments here")
+                    arg_sym = channel_for(analyzer.analyze(c.args[0]),
+                                          name)
+                    if len(c.args) == 2:
+                        if not isinstance(c.args[1], ast.LongLiteral):
+                            raise AnalysisError(
+                                f"{name} offset must be a literal")
+                        offset = c.args[1].value
+                elif name in ("row_number", "rank", "dense_rank"):
+                    if c.args:
+                        raise AnalysisError(f"{name} takes no arguments")
+                elif name in AGG_FNS | VALUE_FNS:
+                    if len(c.args) != 1:
+                        raise AnalysisError(
+                            f"window {name} takes one argument")
+                    arg_sym = channel_for(analyzer.analyze(c.args[0]),
+                                          name)
+                else:
+                    raise AnalysisError(
+                        f"unknown window function {name}")
+                if name in RANKING and frame_mode != "partition" \
+                        and window.frame is not None:
+                    raise AnalysisError(
+                        f"{name} does not take a frame")
+                mode = frame_mode
+                if name in RANKING or name in VALUE_FNS:
+                    mode = "partition"
+                out_t = resolve_window_type(
+                    name, arg_sym.type if arg_sym else None)
+                out_sym = self.allocator.new_symbol(name, out_t)
+                functions.append(
+                    (out_sym, WindowFunctionSpec(name, arg_sym, mode,
+                                                 offset)))
+                replacements[c] = out_sym
+            if len(pre) != len(node.output_symbols):
+                node = ProjectNode(node, pre)
+            node = WindowNode(node, partition_by, orderings, functions)
+            rp = RelationPlan(node, Scope(
+                rp.scope.fields + [FieldDef(None, s, hidden=True)
+                                   for s, _ in functions],
+                rp.scope.parent))
+        return rp, replacements
+
+    def _frame_mode(self, window: ast.Window) -> str:
+        if window.frame is None:
+            return "range" if window.order_by else "partition"
+        ftype, start, end = window.frame
+        if start == "UNBOUNDED PRECEDING" and \
+                end == "UNBOUNDED FOLLOWING":
+            return "partition"
+        if start == "UNBOUNDED PRECEDING" and end == "CURRENT ROW":
+            return ftype.lower()
+        raise AnalysisError(
+            f"window frame {ftype} {start} AND {end} not supported yet")
 
     # ------------------------------------------------------------------
     # WHERE + subqueries

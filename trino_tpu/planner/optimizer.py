@@ -324,6 +324,23 @@ class Optimizer:
             src = self.prune(node.sources[0], need)
             return _replace_source(node, src)
 
+        from .plan import WindowNode
+
+        if isinstance(node, WindowNode):
+            kept = [(s, f) for s, f in node.functions
+                    if s.name in required]
+            src_syms = {s.name for s in node.source.output_symbols}
+            need = (required & src_syms) \
+                | {s.name for s in node.partition_by} \
+                | {o.symbol.name for o in node.orderings} \
+                | {f.argument.name for _, f in kept
+                   if f.argument is not None}
+            src = self.prune(node.source, need)
+            if not kept:
+                return src
+            return WindowNode(src, node.partition_by, node.orderings,
+                              kept)
+
         if isinstance(node, (DistinctNode, IntersectNode, ExceptNode,
                              UnionNode, ValuesNode, EnforceSingleRowNode)):
             # set-semantics nodes need all their columns
@@ -413,8 +430,16 @@ def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
         return ExceptNode(node.symbols, sources)
     if isinstance(node, OutputNode):
         return OutputNode(sources[0], node.column_names, node.outputs)
-    from .plan import ExchangeNode, RemoteSourceNode
+    from .plan import (ExchangeNode, RemoteSourceNode, TableWriterNode,
+                       WindowNode)
 
+    if isinstance(node, WindowNode):
+        return WindowNode(sources[0], node.partition_by, node.orderings,
+                          node.functions)
+    if isinstance(node, TableWriterNode):
+        return TableWriterNode(sources[0], node.catalog, node.schema,
+                               node.table_name, node.columns,
+                               node.rows_symbol, node.create)
     if isinstance(node, ExchangeNode):
         return ExchangeNode(sources[0], node.kind, node.keys)
     if isinstance(node, (TableScanNode, ValuesNode, RemoteSourceNode)):

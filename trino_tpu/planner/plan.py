@@ -308,6 +308,60 @@ class EnforceSingleRowNode(PlanNode):
         return self.source.output_symbols
 
 
+@dataclass(frozen=True)
+class WindowFunctionSpec:
+    """One window call (reference: plan/WindowNode.Function)."""
+
+    function: str
+    argument: Optional[Symbol]
+    frame_mode: str = "range"   # partition | range | rows
+    offset: int = 1             # lag/lead distance, ntile buckets
+
+
+@dataclass
+class WindowNode(PlanNode):
+    """Reference: sql/planner/plan/WindowNode.java — one node per
+    distinct (partition, order, frame) specification."""
+
+    source: PlanNode
+    partition_by: List[Symbol]
+    orderings: List[Ordering]
+    functions: List[Tuple[Symbol, WindowFunctionSpec]]
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return self.source.output_symbols + [s for s, _ in self.functions]
+
+
+@dataclass
+class TableWriterNode(PlanNode):
+    """Write query output to a connector sink; emits one row with the
+    written-row count (reference: plan/TableWriterNode.java +
+    TableFinishNode.java combined — the commit step is the sink's
+    finish()). With ``create=True`` the target table is created at
+    EXECUTION time (CTAS) — planning/EXPLAIN must not mutate metadata."""
+
+    source: PlanNode
+    catalog: str
+    schema: str
+    table_name: str
+    columns: list          # target ColumnHandles in write order
+    rows_symbol: Symbol
+    create: bool = False
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        return [self.rows_symbol]
+
+
 @dataclass
 class ExchangeNode(PlanNode):
     """A stage boundary (reference: sql/planner/plan/ExchangeNode.java,

@@ -64,9 +64,26 @@ class LocalQueryRunner:
     def execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
         if isinstance(stmt, ast.Explain):
+            if stmt.analyze:
+                return self._explain_analyze(stmt.statement)
             text = plan_tree_str(self.plan_statement(stmt.statement))
             return QueryResult(["Query Plan"], [T.VARCHAR],
                                [(line,) for line in text.splitlines()])
+        if isinstance(stmt, ast.SetSession):
+            from . import session_properties as SP
+            from .exec.local_planner import _eval_literal
+            from .sql.analyzer import ExpressionAnalyzer, Scope
+
+            an = ExpressionAnalyzer(Scope([], None), self.session)
+            SP.set_property(self.session.properties, stmt.name,
+                            _eval_literal(an.analyze(stmt.value)))
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        if isinstance(stmt, ast.ShowSession):
+            from . import session_properties as SP
+
+            return QueryResult(
+                ["Name", "Value", "Default", "Type", "Description"],
+                [T.VARCHAR] * 5, SP.listing(self.session))
         if isinstance(stmt, ast.ShowCatalogs):
             return QueryResult(["Catalog"], [T.VARCHAR],
                                [(c,) for c in
@@ -98,8 +115,14 @@ class LocalQueryRunner:
             return QueryResult(
                 ["Column", "Type"], [T.VARCHAR, T.VARCHAR],
                 [(c.name, str(c.type)) for c in columns])
+        if isinstance(stmt, ast.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, ast.DropTable):
+            return self._drop_table(stmt)
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt)
         root = self.plan_statement(stmt)
-        local = LocalExecutionPlanner(self.metadata, self.desired_splits)
+        local = LocalExecutionPlanner(self.metadata, self._splits())
         plan = local.plan(root)
         pages = plan.execute()
         rows: List[tuple] = []
@@ -107,8 +130,111 @@ class LocalQueryRunner:
             rows.extend(p.to_rows())
         return QueryResult(plan.column_names, plan.output_types, rows)
 
+    def _splits(self) -> int:
+        from . import session_properties as SP
+
+        if "desired_splits" in self.session.properties:
+            return SP.value(self.session, "desired_splits")
+        return self.desired_splits
+
+    def _explain_analyze(self, stmt: ast.Statement) -> QueryResult:
+        """Run the query collecting per-operator stats, render the plan
+        + stats (reference: operator/ExplainAnalyzeOperator.java +
+        planprinter/PlanPrinter.java)."""
+        import time as _time
+
+        root = self.plan_statement(stmt)
+        local = LocalExecutionPlanner(self.metadata, self._splits())
+        plan = local.plan(root)
+        t0 = _time.perf_counter()
+        pages = plan.execute(collect_stats=True)
+        wall = _time.perf_counter() - t0
+        out_rows = sum(p.num_rows for p in pages)
+        lines = plan_tree_str(root).splitlines()
+        lines.append("")
+        lines.append(f"Query: {wall * 1e3:.1f}ms, {out_rows} rows")
+        for i, d in enumerate(plan.drivers):
+            lines.append(f"Pipeline {i}:")
+            for st in d.stats:
+                lines.append("  " + st.line())
+        return QueryResult(["Query Plan"], [T.VARCHAR],
+                           [(line,) for line in lines])
+
     def _connector(self, catalog: Optional[str]) -> Connector:
         conn = self.metadata.connectors.get(catalog or "")
         if conn is None:
             raise AnalysisError(f"catalog '{catalog}' does not exist")
         return conn
+
+    def _target(self, name):
+        _, conn, schema, table = self.metadata.resolve_target(
+            name, self.session)
+        return conn, schema, table
+
+    def _create_table(self, stmt: ast.CreateTable) -> QueryResult:
+        from .connectors.spi import ColumnHandle
+
+        conn, schema, table = self._target(stmt.name)
+        if stmt.if_not_exists and \
+                conn.metadata().get_table_handle(schema, table) is not None:
+            return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+        columns = [ColumnHandle(n.lower(), T.parse_type(t), i)
+                   for i, (n, t) in enumerate(stmt.columns)]
+        conn.metadata().create_table(schema, table, columns)
+        return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+
+    def _drop_table(self, stmt: ast.DropTable) -> QueryResult:
+        conn, schema, table = self._target(stmt.name)
+        handle = conn.metadata().get_table_handle(schema, table)
+        if handle is None:
+            if stmt.if_exists:
+                return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+            raise AnalysisError(
+                f"table '{schema}.{table}' does not exist")
+        conn.metadata().drop_table(handle)
+        return QueryResult(["result"], [T.BOOLEAN], [(True,)])
+
+    def _delete(self, stmt: ast.Delete) -> QueryResult:
+        """DELETE via rewrite: keep rows NOT matching the predicate
+        (memory-connector-style storage replacement; reference connectors
+        implement ConnectorMetadata delete handles)."""
+        from .connectors.memory import MemoryConnector
+
+        conn, schema, table = self._target(stmt.table)
+        if not isinstance(conn, MemoryConnector):
+            raise AnalysisError(
+                "DELETE is only supported on the memory connector")
+        handle = conn.metadata().get_table_handle(schema, table)
+        if handle is None:
+            raise AnalysisError(
+                f"table '{schema}.{table}' does not exist")
+        data = conn.tables[(schema, table)]
+        before = data.row_count
+        name = ".".join((conn.catalog_name, schema, table))
+        if stmt.where is None:
+            with data.lock:
+                data.pages = []
+            return QueryResult(["rows"], [T.BIGINT], [(before,)])
+        from .sql.formatter import format_expression
+
+        try:
+            where_text = format_expression(stmt.where)
+        except NotImplementedError:
+            raise AnalysisError(
+                "DELETE with subqueries in WHERE is not supported yet")
+        keep_sql = (f"select * from {name} where "
+                    f"not coalesce(({where_text}), false)")
+        res_pages = [data.canonicalize(p)
+                     for p in self._collect_pages(keep_sql)]
+        with data.lock:
+            data.pages = res_pages
+        return QueryResult(["rows"], [T.BIGINT],
+                           [(before - sum(p.num_rows
+                                          for p in res_pages),)])
+
+    def _collect_pages(self, sql: str) -> List[Page]:
+        stmt = parse_statement(sql)
+        root = self.plan_statement(stmt)
+        local = LocalExecutionPlanner(self.metadata, self._splits())
+        plan = local.plan(root)
+        return plan.execute()

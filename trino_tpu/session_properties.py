@@ -1,0 +1,108 @@
+"""Session property registry: per-query tuning knobs.
+
+Reference analog: ``SystemSessionProperties.java`` (122 properties,
+1,574 LoC) + airlift config binding. Typed defaults with validation;
+``SET SESSION`` updates a Session's overrides, engine components read
+through ``value()``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from .types import TrinoError
+
+
+@dataclass(frozen=True)
+class SessionProperty:
+    name: str
+    type: str            # integer | double | boolean | varchar
+    default: Any
+    description: str
+    validate: Optional[Callable[[Any], bool]] = None
+    normalize: Optional[Callable[[Any], Any]] = None
+
+
+REGISTRY: Dict[str, SessionProperty] = {}
+
+
+def register(prop: SessionProperty):
+    REGISTRY[prop.name] = prop
+    return prop
+
+
+register(SessionProperty(
+    "task_concurrency", "integer", 4,
+    "Parallel worker tasks per fragment",
+    lambda v: v >= 1))
+register(SessionProperty(
+    "desired_splits", "integer", 4,
+    "Target table-scan split count",
+    lambda v: v >= 1))
+register(SessionProperty(
+    "broadcast_join_threshold", "double", 50_000.0,
+    "Estimated build rows below which joins broadcast",
+    lambda v: v >= 0))
+register(SessionProperty(
+    "join_distribution_type", "varchar", "AUTOMATIC",
+    "AUTOMATIC | BROADCAST | PARTITIONED",
+    lambda v: v in ("AUTOMATIC", "BROADCAST", "PARTITIONED"),
+    normalize=str.upper))
+register(SessionProperty(
+    "page_rows", "integer", 65536,
+    "Rows per scan page (device batch size)",
+    lambda v: v >= 64))
+register(SessionProperty(
+    "query_max_memory_bytes", "integer", 8 << 30,
+    "Per-query device-memory accounting limit",
+    lambda v: v > 0))
+register(SessionProperty(
+    "spill_enabled", "boolean", False,
+    "Spill aggregation/join state to host on memory pressure"))
+
+
+def _parse(prop: SessionProperty, raw):
+    try:
+        if prop.type == "integer":
+            return int(raw)
+        if prop.type == "double":
+            return float(raw)
+        if prop.type == "boolean":
+            if isinstance(raw, bool):
+                return raw
+            return str(raw).lower() in ("true", "1", "on")
+        return str(raw)
+    except (TypeError, ValueError):
+        raise TrinoError(
+            f"invalid value {raw!r} for session property {prop.name} "
+            f"({prop.type})", "INVALID_SESSION_PROPERTY")
+
+
+def set_property(properties: Dict[str, Any], name: str, raw):
+    prop = REGISTRY.get(name)
+    if prop is None:
+        raise TrinoError(f"unknown session property: {name}",
+                         "INVALID_SESSION_PROPERTY")
+    value = _parse(prop, raw)
+    if prop.normalize is not None:
+        value = prop.normalize(value)
+    if prop.validate is not None and not prop.validate(value):
+        raise TrinoError(
+            f"value {value!r} out of range for {name}",
+            "INVALID_SESSION_PROPERTY")
+    properties[name] = value
+
+
+def value(session, name: str):
+    prop = REGISTRY[name]
+    return session.properties.get(name, prop.default)
+
+
+def listing(session) -> List[tuple]:
+    out = []
+    for name in sorted(REGISTRY):
+        p = REGISTRY[name]
+        out.append((name, str(value(session, name)), str(p.default),
+                    p.type, p.description))
+    return out

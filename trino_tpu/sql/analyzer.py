@@ -145,6 +145,31 @@ def find_aggregates(e: ast.Expression) -> List[ast.FunctionCall]:
     return out
 
 
+def find_windows(e: ast.Expression) -> List[ast.FunctionCall]:
+    """All window function calls (OVER clauses) in an expression, not
+    descending into subqueries (reference: WindowFunctionExtractor)."""
+    out: List[ast.FunctionCall] = []
+
+    def walk(n):
+        if isinstance(n, (ast.ScalarSubquery, ast.InSubquery,
+                          ast.ExistsPredicate, ast.QuantifiedComparison)):
+            return
+        if isinstance(n, ast.FunctionCall) and n.window is not None:
+            out.append(n)
+            return
+        for f in getattr(n, "__dataclass_fields__", {}):
+            v = getattr(n, f)
+            if isinstance(v, ast.Node):
+                walk(v)
+            elif isinstance(v, tuple):
+                for item in v:
+                    if isinstance(item, ast.Node):
+                        walk(item)
+
+    walk(e)
+    return out
+
+
 def expression_uses_scope(e: ast.Expression) -> bool:
     """Does the expression reference any column (vs pure literals)?"""
     if isinstance(e, (ast.Identifier, ast.DereferenceExpression)):

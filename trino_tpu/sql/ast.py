@@ -419,6 +419,25 @@ class CreateTableAsSelect(Statement):
 
 
 @dataclass(frozen=True)
+class CreateTable(Statement):
+    name: Tuple[str, ...]
+    columns: Tuple[Tuple[str, str], ...]   # (name, type text)
+    if_not_exists: bool = False
+
+
+@dataclass(frozen=True)
+class DropTable(Statement):
+    name: Tuple[str, ...]
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Delete(Statement):
+    table: Tuple[str, ...]
+    where: Optional[Expression] = None
+
+
+@dataclass(frozen=True)
 class Insert(Statement):
     table: Tuple[str, ...]
     query: Query

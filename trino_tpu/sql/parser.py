@@ -53,6 +53,7 @@ KEYWORDS = {
     "partition", "rows", "range", "unbounded", "preceding", "following",
     "current", "row", "if", "coalesce", "nullif", "substring", "for",
     "unnest", "ordinality", "fetch", "next", "only", "exists", "describe",
+    "drop", "delete",
 }
 
 
@@ -200,6 +201,21 @@ class Parser:
             return ast.ShowColumns(self.qualified_name())
         if self.at_kw("create"):
             return self._create()
+        if self.at_kw("drop"):
+            self.advance()
+            self.expect_kw("table")
+            if_exists = bool(self.accept_kw("if"))
+            if if_exists:
+                self.expect_kw("exists")
+            return ast.DropTable(self.qualified_name(), if_exists)
+        if self.at_kw("delete"):
+            self.advance()
+            self.expect_kw("from")
+            name = self.qualified_name()
+            where = None
+            if self.accept_kw("where"):
+                where = self._expression()
+            return ast.Delete(name, where)
         if self.at_kw("insert"):
             self.advance()
             self.expect_kw("into")
@@ -259,9 +275,36 @@ class Parser:
             self.expect_kw("exists")  # via kw 'exists'
             if_not_exists = True
         name = self.qualified_name()
+        if self.at_op("("):
+            # CREATE TABLE t (col type, ...)
+            self.advance()
+            columns = []
+            while True:
+                cname = self.identifier()
+                ttext = self._type_text()
+                columns.append((cname, ttext))
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return ast.CreateTable(name, tuple(columns), if_not_exists)
         self.expect_kw("as")
         return ast.CreateTableAsSelect(name, self.parse_query(),
                                        if_not_exists)
+
+    def _type_text(self) -> str:
+        """A type name with optional (p[,s]) parameters, as raw text."""
+        parts = [self.identifier()]
+        # multi-word types (e.g. "double precision" not supported; keep 1)
+        if self.at_op("("):
+            self.advance()
+            args = [str(self.tok.value)]
+            self.advance()
+            while self.accept_op(","):
+                args.append(str(self.tok.value))
+                self.advance()
+            self.expect_op(")")
+            parts.append("(" + ", ".join(args) + ")")
+        return "".join(parts)
 
     # -- queries -------------------------------------------------------
 
