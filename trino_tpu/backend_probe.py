@@ -1,0 +1,45 @@
+"""Backend init hardening shared by the driver entry points.
+
+Round-1 failure mode: the axon TPU client can crash ("Unable to
+initialize backend") or HANG on init, and a hang can't be interrupted
+in-process. So: probe backend init in a SUBPROCESS with a deadline,
+retry a few times for transient chip locks, then fall back to CPU so
+the caller still produces its artifact (a compile-check or a benchmark
+number) instead of zeroing the round.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+
+_PROBE = ("import jax; d = jax.devices(); "
+          "print('BACKEND_OK', [str(x) for x in d])")
+
+
+def ensure_backend(tag: str, attempts: int = 2,
+                   probe_timeout: int = 120) -> str:
+    """Returns the platform in use: "" (jax default, probe succeeded) or
+    "cpu" (fallback pinned)."""
+    for i in range(attempts):
+        try:
+            out = subprocess.run([sys.executable, "-c", _PROBE],
+                                 capture_output=True, text=True,
+                                 timeout=probe_timeout)
+            if "BACKEND_OK" in out.stdout:
+                sys.stderr.write(f"{tag}: backend probe ok: "
+                                 f"{out.stdout.strip()}\n")
+                return ""
+            sys.stderr.write(f"{tag}: backend probe attempt {i + 1} "
+                             f"rc={out.returncode}:\n{out.stderr[-2000:]}\n")
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"{tag}: backend probe attempt {i + 1} "
+                             f"timed out after {probe_timeout}s\n")
+        time.sleep(5 * (i + 1))
+    sys.stderr.write(f"{tag}: default backend unusable; falling back to "
+                     "CPU so the artifact is still produced\n")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return "cpu"
