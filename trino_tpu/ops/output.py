@@ -19,7 +19,6 @@ one mesh.
 from __future__ import annotations
 
 import threading
-import zlib
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
@@ -27,7 +26,8 @@ import numpy as np
 
 from .. import types as T
 from ..block import Block, Dictionary, DevicePage, Page
-from ..parallel.exchange import hash_partition_ids
+from ..parallel.exchange import (hash_partition_ids, key_to_u64,
+                                 string_hash_lut)
 from .operator import Operator, SourceOperator
 
 
@@ -59,15 +59,6 @@ class OutputBuffer:
             return sum(p.num_rows for ps in self._pages for p in ps)
 
 
-def _string_hash_lut(d: Optional[Dictionary]) -> np.ndarray:
-    """code -> stable value hash (crc32), so equal strings route equally
-    regardless of which dictionary pool coded them."""
-    if d is None or len(d) == 0:
-        return np.zeros(1, dtype=np.uint64)
-    return np.asarray([zlib.crc32(("" if v is None else v).encode())
-                       for v in d.values], dtype=np.uint64)
-
-
 class PartitionedOutputOperator(Operator):
     """Routes each row of the input to an output-buffer partition.
     kind: 'hash' (by key columns), 'single' (partition 0), 'broadcast'.
@@ -93,24 +84,16 @@ class PartitionedOutputOperator(Operator):
         keys_u64 = []
         for c in self.key_channels:
             t = page.types[c]
-            raw, nulls = page.cols[c], page.nulls[c]
+            lut = None
             if t.is_string:
                 d = page.dictionaries[c]
                 key = (id(d), len(d) if d is not None else 0)
                 lut = self._lut_cache.get(key)
                 if lut is None:
-                    lut = _string_hash_lut(d)
+                    lut = string_hash_lut(d)
                     self._lut_cache[key] = lut
-                k = jnp.asarray(lut)[raw]
-            elif t in (T.DOUBLE, T.REAL):
-                # deterministic quantization (equal floats -> equal id);
-                # f64<->u64 bitcasts don't lower on the TPU x64 path
-                k = (jnp.asarray(raw, jnp.float64)
-                     * 65536.0).astype(jnp.int64).view(jnp.uint64)
-            else:
-                k = raw.astype(jnp.int64).view(jnp.uint64)
-            k = jnp.where(nulls, jnp.uint64(0), k)
-            keys_u64.append(k)
+                lut = jnp.asarray(lut)
+            keys_u64.append(key_to_u64(page.cols[c], page.nulls[c], t, lut))
         part = np.asarray(hash_partition_ids(keys_u64, n))
         valid = np.asarray(page.valid)
         cols = [np.asarray(c) for c in page.cols]
@@ -153,12 +136,21 @@ class ExchangeSourceOperator(SourceOperator):
 
     def get_output(self) -> Optional[DevicePage]:
         if self._pages is None:
-            pages = [p for p in self._thunk() if p.num_rows]
-            if pages and any(t.is_string for t in self.types):
-                pages = [Page.concat(pages)]
-            self._pages = pages
+            items = self._thunk()
+            if items and isinstance(items[0], DevicePage):
+                # device-collective exchange: rows arrived by all_to_all
+                # with unified pools — pass straight through
+                self._pages = list(items)
+            else:
+                pages = [p for p in items if p.num_rows]
+                if pages and any(t.is_string for t in self.types):
+                    pages = [Page.concat(pages)]
+                self._pages = pages
         if self._pages:
-            return DevicePage.from_page(self._pages.pop(0))
+            nxt = self._pages.pop(0)
+            if isinstance(nxt, DevicePage):
+                return nxt
+            return DevicePage.from_page(nxt)
         self._done = True
         return None
 

@@ -120,11 +120,38 @@ class DistributedQueryRunner:
 
         return reader
 
+    def _device_exchange_for(self, frag: PlanFragment, ntasks: int):
+        """The flagship TPU-native path: a hash stage boundary between
+        co-resident stages runs as one all_to_all collective over the
+        mesh instead of host-side partitioning (SURVEY.md §2.8). Returns
+        None when the fragment must take the host path."""
+        from .. import session_properties as SP
+
+        if frag.output_kind != "hash" or ntasks != self.n_workers:
+            return None
+        if not SP.value(self.session, "device_exchange"):
+            return None
+        from .device_exchange import (DeviceExchange,
+                                      device_exchange_supported)
+
+        if not device_exchange_supported(
+                [s.type for s in frag.output_symbols]):
+            return None
+        import jax
+
+        devices = jax.devices()
+        if len(devices) < self.n_workers:
+            return None
+        return DeviceExchange(self.n_workers, devices)
+
     def _run_fragment(self, pool, frag: PlanFragment, ntasks: int,
-                      buffers: Dict[int, OutputBuffer]) -> OutputBuffer:
+                      buffers: Dict[int, OutputBuffer]):
         # consumer partition count: single -> 1, hash -> n_workers,
         # broadcast -> replicated
-        if frag.output_kind == "single":
+        device_ex = self._device_exchange_for(frag, ntasks)
+        if device_ex is not None:
+            out = device_ex
+        elif frag.output_kind == "single":
             out = OutputBuffer(1)
         elif frag.output_kind == "broadcast":
             out = OutputBuffer(self.n_workers, broadcast=True)
@@ -137,9 +164,30 @@ class DistributedQueryRunner:
                 task_count=ntasks,
                 exchange_reader=self._make_reader(buffers, t))
             ops, layout, types_ = planner.visit(frag.root)
+            # consumers map RemoteSourceNode symbols positionally, so the
+            # wire layout MUST be output_symbols order — project if the
+            # physical layout differs (ADVICE r1: was only an invariant)
+            out_syms = frag.output_symbols
+            want = [layout[s.name] for s in out_syms]
+            if want != list(range(len(types_))):
+                from ..expr.compiler import PageProcessor
+                from ..expr.ir import InputRef
+                from ..ops.operator import FilterProjectOperator
+
+                proj = [InputRef(types_[c], c) for c in want]
+                ops.append(FilterProjectOperator(
+                    PageProcessor(types_, proj)))
+                types_ = [types_[c] for c in want]
+                layout = {s.name: i for i, s in enumerate(out_syms)}
             key_channels = [layout[s.name] for s in frag.output_keys]
-            ops.append(PartitionedOutputOperator(
-                types_, key_channels, out, frag.output_kind))
+            if device_ex is not None:
+                from .device_exchange import DeviceExchangeSinkOperator
+
+                ops.append(DeviceExchangeSinkOperator(
+                    types_, key_channels, device_ex, t))
+            else:
+                ops.append(PartitionedOutputOperator(
+                    types_, key_channels, out, frag.output_kind))
             planner.pipelines.append(PhysicalPipeline(ops))
             from ..exec.driver import Driver
 

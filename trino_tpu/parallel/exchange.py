@@ -19,12 +19,44 @@ larger factor); with hash partitioning overflow implies heavy skew.
 
 from __future__ import annotations
 
+import zlib
 from functools import partial
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from .. import types as T
+
+
+def string_hash_lut(d) -> np.ndarray:
+    """code -> stable value hash (crc32): equal strings route equally
+    regardless of which dictionary pool coded them. THE one definition —
+    host and device exchange paths must agree or mixed-path joins break."""
+    if d is None or len(d) == 0:
+        return np.zeros(1, dtype=np.uint64)
+    return np.asarray([zlib.crc32(("" if v is None else v).encode())
+                       for v in d.values], dtype=np.uint64)
+
+
+def key_to_u64(raw, nulls, type_: T.Type, lut: Optional[jnp.ndarray] = None):
+    """Value-stable uint64 normalization of one key column for partition
+    hashing (device op). ``lut`` is the string channel's crc LUT. THE one
+    definition shared by the host path (ops/output.PartitionedOutput-
+    Operator) and the device collective (parallel/device_exchange)."""
+    if type_.is_string:
+        k = lut[raw]
+    elif type_ in (T.DOUBLE, T.REAL):
+        # deterministic quantization (equal floats -> equal id); f64<->u64
+        # bitcasts don't lower on the TPU x64 path
+        k = (jnp.asarray(raw, jnp.float64)
+             * 65536.0).astype(jnp.int64).view(jnp.uint64)
+    elif type_ == T.BOOLEAN:
+        k = raw.astype(jnp.uint64)
+    else:
+        k = raw.astype(jnp.int64).view(jnp.uint64)
+    return jnp.where(nulls, jnp.uint64(0), k)
 
 
 def hash_partition_ids(keys_u64: Sequence, num_partitions: int):

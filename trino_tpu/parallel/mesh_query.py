@@ -26,7 +26,7 @@ from typing import List, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..block import Block, Page, padded_size
@@ -71,7 +71,7 @@ def q1_mesh_fn(mesh: Mesh, proc, step, aggs, per_dest: int):
     @partial(shard_map, mesh=mesh,
              in_specs=(P("x"), P("x"), P("x"), P(None)),
              out_specs=(P("x"), P("x"), P("x"), P("x")),
-             check_rep=False)
+             check_vma=False)
     def dist(cols, nulls, valid, luts):
         cols = tuple(c[0] for c in cols)
         nulls = tuple(x[0] for x in nulls)

@@ -60,6 +60,11 @@ register(SessionProperty(
 register(SessionProperty(
     "spill_enabled", "boolean", False,
     "Spill aggregation/join state to host on memory pressure"))
+register(SessionProperty(
+    "device_exchange", "boolean", True,
+    "Run hash exchanges between co-resident stages as an all_to_all "
+    "device collective over the mesh (falls back to the host path when "
+    "tasks outnumber devices or types are host-only)"))
 
 
 def _parse(prop: SessionProperty, raw):
