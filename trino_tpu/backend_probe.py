@@ -2,7 +2,8 @@
 
 Round-1 failure mode: the axon TPU client can crash ("Unable to
 initialize backend") or HANG on init, and a hang can't be interrupted
-in-process. So: probe backend init in a SUBPROCESS with a deadline,
+in-process. So: probe backend init in a GUARDED subprocess (own process
+group, stdout->file, group-killed at the deadline — see subproc.py),
 retry a few times for transient chip locks, then fall back to CPU so
 the caller still produces its artifact (a compile-check or a benchmark
 number) instead of zeroing the round.
@@ -10,9 +11,10 @@ number) instead of zeroing the round.
 
 from __future__ import annotations
 
-import subprocess
 import sys
 import time
+
+from .subproc import run_guarded
 
 _PROBE = ("import jax; d = jax.devices(); "
           "print('BACKEND_OK', [str(x) for x in d])")
@@ -23,19 +25,13 @@ def ensure_backend(tag: str, attempts: int = 2,
     """Returns the platform in use: "" (jax default, probe succeeded) or
     "cpu" (fallback pinned)."""
     for i in range(attempts):
-        try:
-            out = subprocess.run([sys.executable, "-c", _PROBE],
-                                 capture_output=True, text=True,
-                                 timeout=probe_timeout)
-            if "BACKEND_OK" in out.stdout:
-                sys.stderr.write(f"{tag}: backend probe ok: "
-                                 f"{out.stdout.strip()}\n")
-                return ""
-            sys.stderr.write(f"{tag}: backend probe attempt {i + 1} "
-                             f"rc={out.returncode}:\n{out.stderr[-2000:]}\n")
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(f"{tag}: backend probe attempt {i + 1} "
-                             f"timed out after {probe_timeout}s\n")
+        text = run_guarded([sys.executable, "-c", _PROBE],
+                           timeout=probe_timeout, tag=f"{tag}-probe")
+        if "BACKEND_OK" in text:
+            sys.stderr.write(f"{tag}: backend probe ok: {text.strip()}\n")
+            return ""
+        sys.stderr.write(f"{tag}: backend probe attempt {i + 1} failed:\n"
+                         f"{text[-2000:]}\n")
         time.sleep(5 * (i + 1))
     sys.stderr.write(f"{tag}: default backend unusable; falling back to "
                      "CPU so the artifact is still produced\n")
