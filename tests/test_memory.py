@@ -1,0 +1,106 @@
+"""Memory accounting + host spill.
+
+Reference analog: TestMemoryPools / TestMemoryRevokingScheduler — a query
+under an artificially low memory cap completes when spill is enabled
+(revoking operators park state in host RAM) and fails with
+EXCEEDED_LOCAL_MEMORY_LIMIT when it is not.
+"""
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.exec.memory import (MemoryExceededError, QueryMemoryPool,
+                                   device_page_bytes)
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+from trino_tpu.types import TrinoError
+
+# an aggregation + join + sort query with real state to account
+# (q18 shape: big build side, big agg)
+SQL = ("select l_orderkey, sum(l_quantity) qty from lineitem "
+       "group by l_orderkey order by qty desc, l_orderkey limit 10")
+
+JOIN_SQL = ("select o_orderpriority, count(*) from orders o, lineitem l "
+            "where o.o_orderkey = l.l_orderkey and l_quantity > 30 "
+            "group by o_orderpriority order by o_orderpriority")
+
+
+def make_runner(**props):
+    session = Session(catalog="tpch", schema="micro")
+    session.properties.update(props)
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=1024)},
+                            session, desired_splits=8)
+
+
+@pytest.fixture(scope="module")
+def baseline_rows():
+    return {SQL: make_runner().execute(SQL).rows,
+            JOIN_SQL: make_runner().execute(JOIN_SQL).rows}
+
+
+def test_accounting_records_peak():
+    res = make_runner().execute(SQL)
+    mem = res.stats["memory"]
+    assert mem["peak_bytes"] > 0
+    assert mem["spill_events"] == 0
+    assert mem["reserved_bytes"] == 0  # everything released at finish
+
+
+def test_low_cap_without_spill_fails():
+    r = make_runner(query_max_memory_bytes=120_000, spill_enabled=False)
+    with pytest.raises(TrinoError) as exc:
+        r.execute(SQL)
+    assert exc.value.code == "EXCEEDED_LOCAL_MEMORY_LIMIT"
+
+
+def test_low_cap_with_spill_completes(baseline_rows):
+    r = make_runner(query_max_memory_bytes=600_000, spill_enabled=True)
+    res = r.execute(SQL)
+    assert res.rows == baseline_rows[SQL]
+    mem = res.stats["memory"]
+    assert mem["spill_events"] > 0
+    assert mem["spilled_bytes"] > 0
+
+
+def test_join_spill_matches_baseline(baseline_rows):
+    r = make_runner(query_max_memory_bytes=150_000, spill_enabled=True)
+    res = r.execute(JOIN_SQL)
+    assert res.rows == baseline_rows[JOIN_SQL]
+    assert res.stats["memory"]["spill_events"] > 0
+
+
+def test_pool_revokes_largest_first():
+    pool = QueryMemoryPool(1000, spill_enabled=True)
+    order = []
+    a = pool.create_context("a")
+    b = pool.create_context("b")
+    a.set_revoke_callback(lambda: order.append("a") or 600)
+    b.set_revoke_callback(lambda: order.append("b") or 300)
+    a.reserve(600)
+    b.reserve(300)
+    c = pool.create_context("c")
+    c.reserve(500)  # must revoke a (largest) to fit
+    assert order == ["a"]
+    assert pool.reserved == 300 + 500
+    assert pool.spill_events == 1
+
+
+def test_pool_raises_when_spill_disabled():
+    pool = QueryMemoryPool(100, spill_enabled=False)
+    ctx = pool.create_context("x")
+    ctx.reserve(90)
+    with pytest.raises(MemoryExceededError):
+        ctx.reserve(20)
+
+
+def test_device_page_bytes():
+    import jax.numpy as jnp
+
+    from trino_tpu import types as T
+    from trino_tpu.block import DevicePage
+
+    page = DevicePage([T.BIGINT], [jnp.zeros(16, dtype=jnp.int64)],
+                      [jnp.zeros(16, dtype=bool)],
+                      jnp.ones(16, dtype=bool), [None])
+    # 16*8 data + 16 nulls + 16 valid
+    assert device_page_bytes(page) == 16 * 8 + 16 + 16

@@ -72,13 +72,19 @@ class LocalExecutionPlanner:
 
     def __init__(self, metadata: Metadata, desired_splits: int = 4,
                  task_id: int = 0, task_count: int = 1,
-                 exchange_reader=None):
+                 exchange_reader=None, memory_pool=None):
         self.metadata = metadata
         self.desired_splits = desired_splits
         self.task_id = task_id
         self.task_count = task_count
         self.exchange_reader = exchange_reader
+        self.memory_pool = memory_pool
         self.pipelines: List[PhysicalPipeline] = []
+
+    def _mem_ctx(self, name: str):
+        if self.memory_pool is None:
+            return None
+        return self.memory_pool.create_context(name)
 
     def plan(self, root: OutputNode) -> LocalExecutionPlan:
         ops, layout, types_ = self.visit(root.source)
@@ -193,7 +199,9 @@ class LocalExecutionPlanner:
                 build_keys.append(blayout[rsym.name])
 
         bridge = JoinBridge()
-        bops.append(HashBuilderOperator(btypes, build_keys, bridge))
+        bops.append(HashBuilderOperator(
+            btypes, build_keys, bridge,
+            memory_context=self._mem_ctx("join-build")))
         self.pipelines.append(PhysicalPipeline(bops))
 
         filter_fn = None
@@ -254,7 +262,8 @@ class LocalExecutionPlanner:
                 layout = {s.name: i for i, s in enumerate(in_syms)}
                 group_channels = list(range(len(node.group_keys)))
         op = HashAggregationOperator(types_, group_channels, aggs,
-                                     step=node.step)
+                                     step=node.step,
+                                     memory_context=self._mem_ctx("agg"))
         ops.append(op)
         new_layout = {}
         out_types = []
@@ -275,7 +284,9 @@ class LocalExecutionPlanner:
     def _v_DistinctNode(self, node: DistinctNode):
         ops, layout, types_ = self.visit(node.source)
         order = sorted(layout.items(), key=lambda kv: kv[1])
-        op = HashAggregationOperator(types_, [ch for _, ch in order], [])
+        op = HashAggregationOperator(
+            types_, [ch for _, ch in order], [],
+            memory_context=self._mem_ctx("distinct"))
         ops.append(op)
         new_layout = {name: i for i, (name, _) in enumerate(order)}
         return ops, new_layout, types_
@@ -283,7 +294,8 @@ class LocalExecutionPlanner:
     def _v_SortNode(self, node: SortNode):
         ops, layout, types_ = self.visit(node.source)
         keys = _sort_keys(node.orderings, layout)
-        ops.append(OrderByOperator(types_, keys))
+        ops.append(OrderByOperator(types_, keys,
+                                   memory_context=self._mem_ctx("sort")))
         return ops, layout, types_
 
     def _v_TopNNode(self, node: TopNNode):
@@ -407,13 +419,17 @@ class LocalExecutionPlanner:
         # align probe/build channel order to symbol order
         bchans = [blayout[s.name] for s in right.output_symbols]
         bridge = JoinBridge()
-        bops.append(HashBuilderOperator(btypes, bchans, bridge))
+        bops.append(HashBuilderOperator(
+            btypes, bchans, bridge,
+            memory_context=self._mem_ctx("setop-build")))
         self.pipelines.append(PhysicalPipeline(bops))
         pchans = [playout[s.name] for s in left.output_symbols]
         pops.append(LookupJoinOperator(ptypes, pchans, bridge, join_type))
         # distinct over the probe columns; output channels follow pchans
         # order, i.e. channel j <-> left.output_symbols[j] <-> symbols[j]
-        pops.append(HashAggregationOperator(ptypes, pchans, []))
+        pops.append(HashAggregationOperator(
+            ptypes, pchans, [],
+            memory_context=self._mem_ctx("setop-distinct")))
         layout = {s.name: j for j, s in enumerate(node.symbols)}
         out_types = [ptypes[ch] for ch in pchans]
         return pops, layout, out_types

@@ -1,0 +1,302 @@
+"""Per-query memory accounting + host-RAM spill.
+
+Reference analog: ``memory/MemoryPool.java`` (per-node pool with per-query
+reservations), ``lib/trino-memory-context`` (the AggregatedMemoryContext
+tree charged by operators), ``execution/MemoryRevokingScheduler.java:48``
+(pool pressure -> revoke largest revocable operators) and
+``spiller/FileSingleStreamSpiller.java`` (the spill target).
+
+TPU redesign: the scarce resource is device HBM and the spill target is
+host RAM — a device->host transfer of retained ``DevicePage``s into numpy
+arrays, not a file write.  Stateful operators (aggregation partials, join
+build pages, sort buffers) charge the padded byte size of every retained
+page to a per-query ``QueryMemoryPool``; a reservation that would exceed
+``query_max_memory_bytes`` first revokes revocable contexts largest-first
+(when ``spill_enabled``), then fails the query with
+EXCEEDED_MEMORY_LIMIT if still over — the same admission discipline as
+the reference pool's blocking reserve, made synchronous because our
+drivers are synchronous.
+
+Locking: the pool lock and context locks are never held together —
+revoke callbacks run under the victim context's lock only (so they can't
+stall other threads' reserve/free), and pool bookkeeping for the freed
+bytes happens after the context lock is released.  Operators must mutate
+spillable state only under their context lock so a revoke from another
+thread cannot interleave with ``add_input``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..types import TrinoError
+
+
+class MemoryExceededError(TrinoError):
+    def __init__(self, requested: int, reserved: int, limit: int):
+        super().__init__(
+            f"Query exceeded per-query memory limit of {limit} bytes "
+            f"(reserved {reserved}, requested {requested}); "
+            "raise query_max_memory_bytes or enable spill_enabled",
+            "EXCEEDED_LOCAL_MEMORY_LIMIT")
+        self.requested = requested
+        self.reserved = reserved
+        self.limit = limit
+
+
+def device_page_bytes(page) -> int:
+    """Accounted HBM footprint of a DevicePage: padded columns + null
+    masks + the valid mask."""
+    cap = page.capacity
+    total = cap  # valid mask (bool = 1 byte)
+    for c, n in zip(page.cols, page.nulls):
+        total += cap * c.dtype.itemsize
+        total += cap  # null mask
+    return total
+
+
+class SpilledPage:
+    """A DevicePage parked in host RAM.
+
+    Live lanes are compacted to the smallest power-of-two bucket: device
+    pages are often mostly dead lanes (filtered rows, partial-aggregation
+    outputs padded to their input capacity), so compaction shrinks both
+    the host footprint and — more importantly — the HBM needed to bring
+    the page back."""
+
+    __slots__ = ("types", "cols", "nulls", "valid", "dictionaries")
+
+    def __init__(self, page):
+        from ..block import padded_size
+
+        valid = np.asarray(page.valid)
+        keep = np.nonzero(valid)[0]
+        cap = padded_size(len(keep))
+        self.types = list(page.types)
+        self.dictionaries = list(page.dictionaries)
+        if cap < valid.shape[0]:
+            k = len(keep)
+            self.cols = []
+            self.nulls = []
+            for c, n in zip(page.cols, page.nulls):
+                cc = np.zeros(cap, dtype=np.asarray(c).dtype)
+                cc[:k] = np.asarray(c)[keep]
+                nn = np.zeros(cap, dtype=bool)
+                nn[:k] = np.asarray(n)[keep]
+                self.cols.append(cc)
+                self.nulls.append(nn)
+            v = np.zeros(cap, dtype=bool)
+            v[:k] = True
+            self.valid = v
+        else:
+            self.cols = [np.asarray(c) for c in page.cols]
+            self.nulls = [np.asarray(n) for n in page.nulls]
+            self.valid = valid
+
+    @property
+    def capacity(self) -> int:
+        return int(self.valid.shape[0])
+
+    def to_device(self):
+        import jax.numpy as jnp
+
+        from ..block import DevicePage
+
+        return DevicePage(list(self.types),
+                          [jnp.asarray(c) for c in self.cols],
+                          [jnp.asarray(n) for n in self.nulls],
+                          jnp.asarray(self.valid),
+                          list(self.dictionaries))
+
+
+def spill_pages(pages: List) -> int:
+    """Convert DevicePage entries to SpilledPage in place (caller holds
+    the owning context's lock); returns the HBM bytes freed."""
+    from ..block import DevicePage
+
+    freed = 0
+    for i, p in enumerate(pages):
+        if isinstance(p, DevicePage):
+            freed += device_page_bytes(p)
+            pages[i] = SpilledPage(p)
+    return freed
+
+
+def reserve_and_append(ctx: "OperatorMemoryContext", pages: List, page):
+    """The add_input discipline shared by spillable operators: charge the
+    page, then publish it to the revocable list under the context lock."""
+    ctx.reserve(device_page_bytes(page))
+    with ctx.lock:
+        pages.append(page)
+
+
+def prepare_finish(ctx: "OperatorMemoryContext", pages: List):
+    """Shared finish-time transition for spillable operators: their pages
+    stop being revocable (the finish pass owns them), so if the finish
+    transient (~2x total for concat + result) would not fit alongside the
+    current reservations, park everything on host first — spill compacts
+    dead lanes, so totals are recomputed from parked sizes (= what
+    re-upload actually costs).  Returns (total, uploads)."""
+    pool = ctx.pool
+    with ctx.lock:
+        total = sum(device_page_bytes(p) for p in pages)
+        uploads = sum(device_page_bytes(p) for p in pages
+                      if isinstance(p, SpilledPage))
+        freed = 0
+        if pool.spill_enabled and \
+                pool.reserved + uploads + 2 * total > pool.max_bytes:
+            freed = spill_pages(pages)
+            total = sum(device_page_bytes(p) for p in pages)
+            uploads = total
+        # clear the callback INSIDE the lock: a concurrent pool revoke
+        # between the totals above and here would invalidate them
+        ctx.set_revoke_callback(None)
+    if freed:
+        pool.record_spill(freed)
+        ctx.free(freed)
+    return total, uploads
+
+
+class OperatorMemoryContext:
+    """One operator's slice of the query pool (reference:
+    ``memory/context/LocalMemoryContext``).
+
+    ``lock`` guards the owner's spillable state; a revoke callback runs
+    under it.  ``reserve``/``free`` must be called WITHOUT holding it.
+    """
+
+    def __init__(self, pool: "QueryMemoryPool", name: str):
+        self.pool = pool
+        self.name = name
+        self.lock = threading.RLock()
+        self.reserved = 0
+        self.revocable = 0          # portion of reserved that revoke can free
+        self._revoke_cb: Optional[Callable[[], int]] = None
+
+    def set_revoke_callback(self, cb: Callable[[], int]):
+        """cb() spills the owner's revocable state to host and returns the
+        bytes freed (reference: Operator.startMemoryRevoke)."""
+        self._revoke_cb = cb
+
+    def reserve(self, nbytes: int, revocable: bool = True):
+        if nbytes <= 0:
+            return
+        self.pool._reserve(self, nbytes, revocable)
+
+    def free(self, nbytes: int, revocable: bool = True):
+        if nbytes <= 0:
+            return
+        self.pool._free(self, nbytes, revocable)
+
+    def close(self):
+        if self.reserved:
+            self.pool._free(self, self.reserved, revocable=False)
+            self.revocable = 0
+
+
+class QueryMemoryPool:
+    """Per-query HBM accounting with synchronous revocation.
+
+    Reference: ``memory/MemoryPool.java`` + ``QueryContext`` — collapsed
+    to one pool per query because device HBM is per-process here.
+    """
+
+    def __init__(self, max_bytes: int, spill_enabled: bool = False):
+        self.max_bytes = int(max_bytes)
+        self.spill_enabled = spill_enabled
+        self.reserved = 0
+        self.peak_bytes = 0
+        self.spill_events = 0
+        self.spilled_bytes = 0
+        self._lock = threading.Lock()
+        self._contexts: List[OperatorMemoryContext] = []
+
+    def create_context(self, name: str) -> OperatorMemoryContext:
+        ctx = OperatorMemoryContext(self, name)
+        with self._lock:
+            self._contexts.append(ctx)
+        return ctx
+
+    # -- internal (called by contexts) ----------------------------------
+
+    def _reserve(self, ctx: OperatorMemoryContext, nbytes: int,
+                 revocable: bool):
+        with self._lock:
+            if self.reserved + nbytes <= self.max_bytes:
+                self._admit_locked(ctx, nbytes, revocable)
+                return
+            if not self.spill_enabled:
+                raise MemoryExceededError(nbytes, self.reserved,
+                                          self.max_bytes)
+            # requester's own state first: self-revoke is deadlock-free
+            # (its RLock is reentrant on the calling thread) and the
+            # largest state usually belongs to the operator asking for
+            # more
+            candidates = sorted(self._contexts,
+                                key=lambda c: (c is not ctx, -c.revocable))
+        # Revoke OUTSIDE the pool lock: callbacks move whole operator
+        # states device->host, and other threads' reserve/free must not
+        # serialize behind that transfer (reference:
+        # MemoryRevokingScheduler revokes asynchronously).
+        for c in candidates:
+            with self._lock:
+                if self.reserved + nbytes <= self.max_bytes:
+                    break
+            if c.revocable <= 0:
+                continue
+            with c.lock:
+                cb = c._revoke_cb
+                freed = cb() if cb is not None else 0
+            if freed > 0:
+                self.record_spill(freed)
+                self._free(c, freed, revocable=True)
+        with self._lock:
+            if self.reserved + nbytes > self.max_bytes:
+                raise MemoryExceededError(nbytes, self.reserved,
+                                          self.max_bytes)
+            self._admit_locked(ctx, nbytes, revocable)
+
+    def _admit_locked(self, ctx, nbytes, revocable):
+        self.reserved += nbytes
+        ctx.reserved += nbytes
+        if revocable:
+            ctx.revocable += nbytes
+        self.peak_bytes = max(self.peak_bytes, self.reserved)
+
+    def _free(self, ctx: OperatorMemoryContext, nbytes: int,
+              revocable: bool):
+        with self._lock:
+            self._free_locked(ctx, nbytes, revocable)
+
+    def _free_locked(self, ctx, nbytes, revocable):
+        nbytes = min(nbytes, ctx.reserved)
+        self.reserved -= nbytes
+        ctx.reserved -= nbytes
+        if revocable:
+            ctx.revocable = max(0, ctx.revocable - nbytes)
+
+    def record_spill(self, freed: int):
+        with self._lock:
+            self.spill_events += 1
+            self.spilled_bytes += freed
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "reserved_bytes": self.reserved,
+            "peak_bytes": self.peak_bytes,
+            "max_bytes": self.max_bytes,
+            "spill_events": self.spill_events,
+            "spilled_bytes": self.spilled_bytes,
+        }
+
+
+def pool_from_session(session) -> QueryMemoryPool:
+    from .. import session_properties as SP
+
+    return QueryMemoryPool(SP.value(session, "query_max_memory_bytes"),
+                           SP.value(session, "spill_enabled"))

@@ -278,18 +278,22 @@ class HashAggregationOperator(Operator):
 
     def __init__(self, input_types: Sequence[T.Type],
                  group_channels: Sequence[int],
-                 aggregates: Sequence[AggCall], step: str = "single"):
+                 aggregates: Sequence[AggCall], step: str = "single",
+                 memory_context=None):
         assert step in ("single", "partial", "final")
         self.input_types = list(input_types)
         self.group_channels = list(group_channels)
         self.aggregates = list(aggregates)
         self.step = step
-        self._partials: List[DevicePage] = []
+        self._partials: List = []  # DevicePage | SpilledPage entries
         self._emitted = False
         self._done = False
         self._group_dicts: List = [None] * len(group_channels)
         self._kinds = tuple(k for a in self.aggregates
                             for (k, _) in _state_plan(a))
+        self._ctx = memory_context
+        if self._ctx is not None:
+            self._ctx.set_revoke_callback(self._revoke)
 
     # output layout: group key columns, then state/final columns per agg
     @property
@@ -313,8 +317,21 @@ class HashAggregationOperator(Operator):
                         "group key dictionaries changed across pages; "
                         "exchange must unify pools")
                 self._group_dicts[i] = d
-        self._partials.append(self._aggregate_page(
-            page, intermediate=self.step == "final"))
+        partial = self._aggregate_page(page,
+                                       intermediate=self.step == "final")
+        if self._ctx is None:
+            self._partials.append(partial)
+            return
+        from ..exec.memory import reserve_and_append
+
+        reserve_and_append(self._ctx, self._partials, partial)
+
+    def _revoke(self) -> int:
+        """Park device partials in host RAM (called by the pool under
+        this context's lock; reference: Operator.startMemoryRevoke)."""
+        from ..exec.memory import spill_pages
+
+        return spill_pages(self._partials)
 
     def _aggregate_page(self, page: DevicePage,
                         intermediate: bool) -> DevicePage:
@@ -378,8 +395,11 @@ class HashAggregationOperator(Operator):
         self._emitted = True
         self._done = True
         merged = self._merge_partials()
+        self._partials = []
         if self.step in ("single", "final"):
-            return self._finalize(merged)
+            merged = self._finalize(merged)
+        if self._ctx is not None:
+            self._ctx.close()  # output page is in flight, not retained
         return merged
 
     def _merge_partials(self) -> DevicePage:
@@ -392,6 +412,14 @@ class HashAggregationOperator(Operator):
         for i in range(nkeys):
             if self._group_dicts[i] is None and types[i].is_string:
                 self._group_dicts[i] = Dictionary()
+        if self._ctx is not None:
+            # once merging starts the partials stop being revocable; if
+            # the single-chunk transient (concat + result ~= 2x total)
+            # wouldn't fit, prepare_finish parks everything on host and
+            # the chunked merge below brings it back under budget
+            from ..exec.memory import prepare_finish
+
+            prepare_finish(self._ctx, self._partials)
         if not self._partials:
             # no input: zero groups — except global aggregation, which
             # emits exactly one group of empty-input states (count=0,
@@ -404,21 +432,80 @@ class HashAggregationOperator(Operator):
                 valid = valid.at[0].set(True)
             dicts = list(self._group_dicts) + [None] * (len(types) - nkeys)
             return DevicePage(types, cols, nulls, valid, dicts)
-        if len(self._partials) == 1 and self.step != "partial":
-            return self._partials[0]
-        # concatenate partials on device and re-group with merge semantics
-        cap = padded_size(sum(p.capacity for p in self._partials))
-        cols, nulls = [], []
-        for i in range(len(types)):
-            c = jnp.concatenate([p.cols[i] for p in self._partials])
-            n = jnp.concatenate([p.nulls[i] for p in self._partials])
-            cols.append(_pad_to(c, cap))
-            nulls.append(_pad_to(n, cap))
-        valid = _pad_to(
-            jnp.concatenate([p.valid for p in self._partials]), cap)
-        page = DevicePage(types, cols, nulls, valid,
-                          list(self._group_dicts) + [None] * (len(types) - nkeys))
-        return self._aggregate_page(page, intermediate=True)
+        from ..exec.memory import SpilledPage, device_page_bytes
+
+        parts = self._partials
+        if len(parts) == 1 and self.step != "partial" \
+                and not isinstance(parts[0], SpilledPage):
+            return parts[0]
+        # merge in budget-bounded chunks: each round touches at most
+        # ~budget bytes of HBM (uploads + concat), so spilled state
+        # re-enters the device incrementally (reference analog:
+        # MergingHashAggregationBuilder merging sorted spill runs)
+        budget = None
+        if self._ctx is not None:
+            # each chunk's transient is 2x its bytes (concat + result):
+            # cap chunks at max/4 so the transient stays under max/2
+            budget = max(self._ctx.pool.max_bytes // 4, 1 << 16)
+        while True:
+            chunks: List[List] = []
+            cur: List = []
+            cur_bytes = 0
+            for p in parts:
+                nb = device_page_bytes(p)
+                if cur and len(cur) >= 2 and budget is not None \
+                        and cur_bytes + nb > budget:
+                    chunks.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(p)
+                cur_bytes += nb
+            chunks.append(cur)
+            if len(chunks) == 1:
+                return self._merge_chunk(chunks[0])
+            parts = [self._merge_chunk(c) for c in chunks]
+
+    def _merge_chunk(self, chunk: List) -> DevicePage:
+        """Concatenate one chunk of partials (uploading spilled ones) and
+        re-group with merge semantics."""
+        from ..exec.memory import SpilledPage, device_page_bytes
+
+        types = self._intermediate_types()
+        nkeys = len(self.group_channels)
+        total = sum(device_page_bytes(p) for p in chunk)
+        transient = 0
+        if self._ctx is not None:
+            # uploads (spilled entries re-entering HBM) + concat buffer +
+            # result (bounded by the concat)
+            uploads = sum(device_page_bytes(p) for p in chunk
+                          if isinstance(p, SpilledPage))
+            transient = uploads + 2 * total
+            self._ctx.reserve(transient, revocable=False)
+        dev = [p.to_device() if isinstance(p, SpilledPage) else p
+               for p in chunk]
+        if len(dev) == 1 and self.step != "partial" and \
+                isinstance(chunk[0], SpilledPage):
+            out = dev[0]
+        else:
+            cap = padded_size(sum(p.capacity for p in dev))
+            cols, nulls = [], []
+            for i in range(len(types)):
+                c = jnp.concatenate([p.cols[i] for p in dev])
+                n = jnp.concatenate([p.nulls[i] for p in dev])
+                cols.append(_pad_to(c, cap))
+                nulls.append(_pad_to(n, cap))
+            valid = _pad_to(jnp.concatenate([p.valid for p in dev]), cap)
+            page = DevicePage(
+                types, cols, nulls, valid,
+                list(self._group_dicts) + [None] * (len(types) - nkeys))
+            out = self._aggregate_page(page, intermediate=True)
+        if self._ctx is not None:
+            # release the transient + the chunk inputs' reservations,
+            # keep the merged result reserved
+            freed = transient + sum(device_page_bytes(p) for p in chunk
+                                    if not isinstance(p, SpilledPage))
+            self._ctx.free(freed)
+            self._ctx.reserve(device_page_bytes(out), revocable=False)
+        return out
 
     def _finalize(self, merged: DevicePage) -> DevicePage:
         nkeys = len(self.group_channels)

@@ -138,24 +138,50 @@ class JoinBridge:
 
     def __init__(self):
         self.build: Optional[BuildSide] = None
+        self.release = None  # set by the builder; probe calls at finish
 
     def set_build(self, b: BuildSide):
         self.build = b
+
+    def destroy(self):
+        """Probe side is done: drop the build index + its memory
+        reservation (reference: LookupSourceFactory destroy)."""
+        self.build = None
+        if self.release is not None:
+            self.release()
+            self.release = None
 
 
 class HashBuilderOperator(Operator):
     """Accumulates the build side and publishes a sorted index."""
 
     def __init__(self, input_types: Sequence[T.Type],
-                 key_channels: Sequence[int], bridge: JoinBridge):
+                 key_channels: Sequence[int], bridge: JoinBridge,
+                 memory_context=None):
         self.input_types = list(input_types)
         self.key_channels = list(key_channels)
         self.bridge = bridge
-        self._pages: List[DevicePage] = []
+        self._pages: List = []  # DevicePage | SpilledPage
         self._done = False
+        self._ctx = memory_context
+        if self._ctx is not None:
+            self._ctx.set_revoke_callback(self._revoke)
 
     def add_input(self, page: DevicePage):
-        self._pages.append(page)
+        if self._ctx is None:
+            self._pages.append(page)
+            return
+        from ..exec.memory import reserve_and_append
+
+        reserve_and_append(self._ctx, self._pages, page)
+
+    def _revoke(self) -> int:
+        """Park build pages in host RAM until publish (reference:
+        HashBuilderOperator's CONSUMING_INPUT -> SPILLING_INPUT states —
+        here the spill target is host RAM, not disk)."""
+        from ..exec.memory import spill_pages
+
+        return spill_pages(self._pages)
 
     def get_output(self):
         if self._finishing and not self._done:
@@ -164,16 +190,48 @@ class HashBuilderOperator(Operator):
         return None
 
     def _publish(self):
+        from ..exec.memory import SpilledPage, device_page_bytes
+
+        if self._ctx is not None:
+            # publish owns the state; the build index it creates is
+            # retained (non-revocable) for the probe's lifetime
+            from ..exec.memory import prepare_finish
+
+            total, uploads = prepare_finish(self._ctx, self._pages)
+            all_spilled = bool(self._pages) and all(
+                isinstance(p, SpilledPage) for p in self._pages)
+            # transient: concat + sorted copy, plus per-page re-uploads
+            # on the mixed path (the all-spilled path concatenates in
+            # host RAM and uploads once — no per-page residency)
+            self._ctx.reserve((2 * total if all_spilled
+                               else uploads + 2 * total), revocable=False)
         if self._pages:
-            cap = padded_size(sum(p.capacity for p in self._pages))
-            cols, nulls = [], []
-            nch = len(self.input_types)
-            for i in range(nch):
-                cols.append(_pad_concat([p.cols[i] for p in self._pages], cap))
-                nulls.append(_pad_concat([p.nulls[i] for p in self._pages],
-                                         cap, fill=True))
-            valid = _pad_concat([p.valid for p in self._pages], cap)
-            dicts = self._unified_dicts()
+            spilled = [p for p in self._pages if isinstance(p, SpilledPage)]
+            if spilled and len(spilled) == len(self._pages):
+                # pressure path: concatenate in host RAM, upload once
+                cap = padded_size(sum(p.capacity for p in self._pages))
+                cols, nulls = [], []
+                nch = len(self.input_types)
+                for i in range(nch):
+                    c = np.concatenate([p.cols[i] for p in self._pages])
+                    n = np.concatenate([p.nulls[i] for p in self._pages])
+                    cols.append(jnp.asarray(_np_pad(c, cap)))
+                    nulls.append(jnp.asarray(_np_pad(n, cap, fill=True)))
+                v = np.concatenate([p.valid for p in self._pages])
+                valid = jnp.asarray(_np_pad(v, cap))
+                dicts = self._unified_dicts(self._pages)
+            else:
+                pages = [p.to_device() if isinstance(p, SpilledPage) else p
+                         for p in self._pages]
+                cap = padded_size(sum(p.capacity for p in pages))
+                cols, nulls = [], []
+                nch = len(self.input_types)
+                for i in range(nch):
+                    cols.append(_pad_concat([p.cols[i] for p in pages], cap))
+                    nulls.append(_pad_concat([p.nulls[i] for p in pages],
+                                             cap, fill=True))
+                valid = _pad_concat([p.valid for p in pages], cap)
+                dicts = self._unified_dicts(pages)
         else:
             from ..block import Dictionary
 
@@ -202,10 +260,18 @@ class HashBuilderOperator(Operator):
             valid)
         self.bridge.set_build(BuildSide(ks, us, scols, snulls,
                                         self.input_types, dicts, kc, mode))
+        self._pages = []  # release the input pages; only the index remains
+        if self._ctx is not None:
+            # retain only the published index: sorted key (8B) + usable
+            # (1B) + per-channel data/null lanes
+            retained = cap * (9 + sum(c.dtype.itemsize + 1 for c in scols))
+            self._ctx.close()
+            self._ctx.reserve(retained, revocable=False)
+            self.bridge.release = self._ctx.close
 
-    def _unified_dicts(self):
+    def _unified_dicts(self, pages):
         dicts = [None] * len(self.input_types)
-        for p in self._pages:
+        for p in pages:
             for i, d in enumerate(p.dictionaries):
                 if d is not None:
                     if dicts[i] is None:
@@ -258,6 +324,8 @@ class LookupJoinOperator(Operator):
     def get_output(self):
         out, self._pending = self._pending, None
         if out is None and self._finishing:
+            if not self._done:
+                self.bridge.destroy()
             self._done = True
         return out
 
@@ -401,6 +469,16 @@ def _semi_matched(lo, count, pkey_cols, bkey_cols, probe_cap: int,
     matched = jnp.zeros(probe_cap + 1, dtype=bool)
     matched = matched.at[jnp.where(keep, probe_idx, probe_cap)].max(True)
     return matched[:-1]
+
+
+def _np_pad(arr: np.ndarray, cap: int, fill: bool = False) -> np.ndarray:
+    n = arr.shape[0]
+    if n == cap:
+        return arr
+    out = np.full(cap, fill, dtype=bool) if arr.dtype == bool \
+        else np.zeros(cap, dtype=arr.dtype)
+    out[:n] = arr
+    return out
 
 
 def _pad_concat(arrays, cap: int, fill: bool = False):

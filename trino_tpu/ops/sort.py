@@ -85,15 +85,28 @@ class OrderByOperator(Operator):
     """Full sort at finish (reference: OrderByOperator.java)."""
 
     def __init__(self, input_types: Sequence[T.Type],
-                 sort_keys: Sequence[SortKey]):
+                 sort_keys: Sequence[SortKey], memory_context=None):
         self.input_types = list(input_types)
         self.sort_keys = list(sort_keys)
-        self._pages: List[DevicePage] = []
+        self._pages: List = []  # DevicePage | SpilledPage
         self._emitted = False
         self._done = False
+        self._ctx = memory_context
+        if self._ctx is not None:
+            self._ctx.set_revoke_callback(self._revoke)
 
     def add_input(self, page: DevicePage):
-        self._pages.append(page)
+        if self._ctx is None:
+            self._pages.append(page)
+            return
+        from ..exec.memory import reserve_and_append
+
+        reserve_and_append(self._ctx, self._pages, page)
+
+    def _revoke(self) -> int:
+        from ..exec.memory import spill_pages
+
+        return spill_pages(self._pages)
 
     def get_output(self) -> Optional[DevicePage]:
         if not self._finishing or self._emitted:
@@ -102,12 +115,26 @@ class OrderByOperator(Operator):
         self._done = True
         if not self._pages:
             return None
+        from ..exec.memory import SpilledPage
+
+        if self._ctx is not None:
+            from ..exec.memory import prepare_finish
+
+            total, uploads = prepare_finish(self._ctx, self._pages)
+            # transient: uploads + concat + sorted copy; released when
+            # the sorted page flows downstream
+            self._ctx.reserve(uploads + 2 * total, revocable=False)
+        self._pages = [p.to_device() if isinstance(p, SpilledPage) else p
+                       for p in self._pages]
         cap = padded_size(sum(p.capacity for p in self._pages))
         page = _concat_pages(self._pages, cap)
         key_ops = _make_key_ops(page, self.sort_keys)
         cols, nulls, valid = _sorted_by(key_ops, tuple(page.cols),
                                         tuple(page.nulls), page.valid,
                                         num_key_ops=len(key_ops))
+        self._pages = []
+        if self._ctx is not None:
+            self._ctx.close()
         return DevicePage(page.types, list(cols), list(nulls), valid,
                           page.dictionaries)
 

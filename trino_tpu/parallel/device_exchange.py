@@ -70,6 +70,12 @@ class DeviceExchange:
         self.a2a_retries = 0
         self.collective_ran = False  # test observability
 
+    #: process-wide count of executed collectives (dryrun/test
+    #: observability); guarded by _total_lock — instances have their own
+    #: locks, and two exchanges can collect concurrently
+    total_collectives = 0
+    _total_lock = threading.Lock()
+
     # -- producer side --------------------------------------------------
 
     def configure(self, types_: Sequence[T.Type],
@@ -192,6 +198,8 @@ class DeviceExchange:
             self.a2a_retries += 1
 
         self.collective_ran = True
+        with DeviceExchange._total_lock:
+            DeviceExchange.total_collectives += 1
         # release producer-side inputs: without this the exchange pins
         # ~2x the exchanged bytes in HBM for the rest of the query
         self._by_task.clear()

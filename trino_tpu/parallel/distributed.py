@@ -49,6 +49,18 @@ class DistributedQueryRunner:
             catalog=next(iter(connectors), None))
         self.n_workers = n_workers if n_workers is not None \
             else SP.value(self.session, "task_concurrency")
+        # Task THREADS are capped by physical cores: n_workers sets the
+        # partitioning (task count / mesh width), but running more
+        # dispatching threads than cores adds no parallelism and can
+        # deadlock the XLA CPU client's core-sized thread pools (observed
+        # on 1-core hosts: 8 threads concurrently dispatching onto an
+        # 8-virtual-device client starve each other's async executes).
+        # Real deployments put tasks in separate processes anyway
+        # (reference: one TaskExecutor per worker JVM).
+        import os as _os
+
+        self.pool_threads = max(1, min(self.n_workers,
+                                       _os.cpu_count() or 1))
         self.desired_splits = desired_splits
         self.broadcast_threshold = broadcast_threshold \
             if broadcast_threshold is not None \
@@ -86,8 +98,14 @@ class DistributedQueryRunner:
         root: OutputNode = self._root
         buffers: Dict[int, OutputBuffer] = {}
         result_pages: List[Page] = []
+        from ..exec.memory import pool_from_session
 
-        with ThreadPoolExecutor(max_workers=self.n_workers) as pool:
+        # one pool per query across all tasks: device HBM is a
+        # per-process resource (reference: ClusterMemoryManager enforcing
+        # a query's global limit over per-node reservations)
+        self._memory_pool = pool_from_session(self.session)
+
+        with ThreadPoolExecutor(max_workers=self.pool_threads) as pool:
             for frag in fragments:
                 ntasks = 1 if frag.partitioning == "single" \
                     else self.n_workers
@@ -104,7 +122,8 @@ class DistributedQueryRunner:
             rows.extend(p.to_rows())
         names = root.column_names
         types_ = [s.type for s in root.outputs]
-        return QueryResult(names, types_, rows)
+        return QueryResult(names, types_, rows,
+                           stats={"memory": self._memory_pool.stats()})
 
     # ------------------------------------------------------------------
 
@@ -162,7 +181,8 @@ class DistributedQueryRunner:
             planner = LocalExecutionPlanner(
                 self.metadata, self.desired_splits, task_id=t,
                 task_count=ntasks,
-                exchange_reader=self._make_reader(buffers, t))
+                exchange_reader=self._make_reader(buffers, t),
+                memory_pool=self._memory_pool)
             ops, layout, types_ = planner.visit(frag.root)
             # consumers map RemoteSourceNode symbols positionally, so the
             # wire layout MUST be output_symbols order — project if the
@@ -206,7 +226,8 @@ class DistributedQueryRunner:
             planner = LocalExecutionPlanner(
                 self.metadata, self.desired_splits, task_id=t,
                 task_count=ntasks,
-                exchange_reader=self._make_reader(buffers, t))
+                exchange_reader=self._make_reader(buffers, t),
+                memory_pool=self._memory_pool)
             plan = planner.plan(OutputNode(frag.root, root.column_names,
                                            root.outputs))
             results[t] = plan.execute()

@@ -29,6 +29,7 @@ class QueryResult:
     column_names: List[str]
     types: List[T.Type]
     rows: List[tuple]
+    stats: Optional[dict] = None
 
     def only_value(self):
         assert len(self.rows) == 1 and len(self.rows[0]) == 1, self.rows
@@ -122,13 +123,18 @@ class LocalQueryRunner:
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt)
         root = self.plan_statement(stmt)
-        local = LocalExecutionPlanner(self.metadata, self._splits())
+        from .exec.memory import pool_from_session
+
+        pool = pool_from_session(self.session)
+        local = LocalExecutionPlanner(self.metadata, self._splits(),
+                                      memory_pool=pool)
         plan = local.plan(root)
         pages = plan.execute()
         rows: List[tuple] = []
         for p in pages:
             rows.extend(p.to_rows())
-        return QueryResult(plan.column_names, plan.output_types, rows)
+        return QueryResult(plan.column_names, plan.output_types, rows,
+                           stats={"memory": pool.stats()})
 
     def _splits(self) -> int:
         from . import session_properties as SP
@@ -144,7 +150,11 @@ class LocalQueryRunner:
         import time as _time
 
         root = self.plan_statement(stmt)
-        local = LocalExecutionPlanner(self.metadata, self._splits())
+        from .exec.memory import pool_from_session
+
+        pool = pool_from_session(self.session)
+        local = LocalExecutionPlanner(self.metadata, self._splits(),
+                                      memory_pool=pool)
         plan = local.plan(root)
         t0 = _time.perf_counter()
         pages = plan.execute(collect_stats=True)
@@ -153,6 +163,10 @@ class LocalQueryRunner:
         lines = plan_tree_str(root).splitlines()
         lines.append("")
         lines.append(f"Query: {wall * 1e3:.1f}ms, {out_rows} rows")
+        m = pool.stats()
+        lines.append(
+            f"Memory: peak {m['peak_bytes']} bytes, "
+            f"{m['spill_events']} spills ({m['spilled_bytes']} bytes)")
         for i, d in enumerate(plan.drivers):
             lines.append(f"Pipeline {i}:")
             for st in d.stats:
@@ -235,6 +249,10 @@ class LocalQueryRunner:
     def _collect_pages(self, sql: str) -> List[Page]:
         stmt = parse_statement(sql)
         root = self.plan_statement(stmt)
-        local = LocalExecutionPlanner(self.metadata, self._splits())
+        from .exec.memory import pool_from_session
+
+        local = LocalExecutionPlanner(self.metadata, self._splits(),
+                                      memory_pool=pool_from_session(
+                                          self.session))
         plan = local.plan(root)
         return plan.execute()
