@@ -362,6 +362,24 @@ class DevicePage:
         return Page(blocks, len(keep))
 
 
+def unify_dictionaries(pages, n_channels: int):
+    """The one dictionary-pool compatibility rule for co-flowing pages:
+    all non-None pools of a channel must be the SAME object (exchange
+    boundaries re-encode divergent pools; everything downstream relies
+    on identity).  Returns the per-channel pools or raises."""
+    dicts = [None] * n_channels
+    for p in pages:
+        for i, d in enumerate(p.dictionaries):
+            if d is not None:
+                if dicts[i] is None:
+                    dicts[i] = d
+                elif dicts[i] is not d:
+                    raise T.TrinoError(
+                        "dictionary pools differ across pages; exchange "
+                        "must unify pools", "GENERIC_INTERNAL_ERROR")
+    return dicts
+
+
 def empty_page(types_: Sequence[T.Type],
                dictionaries: Optional[Sequence] = None) -> Page:
     blocks = []

@@ -72,14 +72,21 @@ class LocalExecutionPlanner:
 
     def __init__(self, metadata: Metadata, desired_splits: int = 4,
                  task_id: int = 0, task_count: int = 1,
-                 exchange_reader=None, memory_pool=None):
+                 exchange_reader=None, memory_pool=None,
+                 join_max_lanes: Optional[int] = None,
+                 dynamic_filtering: bool = True):
         self.metadata = metadata
         self.desired_splits = desired_splits
         self.task_id = task_id
         self.task_count = task_count
         self.exchange_reader = exchange_reader
         self.memory_pool = memory_pool
+        self.join_max_lanes = join_max_lanes
+        self.dynamic_filtering = dynamic_filtering
         self.pipelines: List[PhysicalPipeline] = []
+        # scan-node id -> [(channel, DynamicFilter)] attachments
+        self._scan_dfs: Dict[int, List] = {}
+        self.dynamic_filters: List = []  # all filters, for query stats
 
     def _mem_ctx(self, name: str):
         if self.memory_pool is None:
@@ -116,7 +123,9 @@ class LocalExecutionPlanner:
     def _v_TableScanNode(self, node: TableScanNode):
         conn = self.metadata.connectors[node.catalog]
         columns = [c for _, c in node.assignments]
-        scan = TableScanOperator(conn, columns)
+        scan = TableScanOperator(conn, columns,
+                                 dynamic_filters=self._scan_dfs.pop(
+                                     id(node), []))
         splits = conn.split_manager().get_splits(node.table,
                                                  self.desired_splits)
         for i, split in enumerate(splits):
@@ -170,6 +179,15 @@ class LocalExecutionPlanner:
     def _plan_join(self, join_type: str, left: PlanNode, right: PlanNode,
                    criteria: List[Tuple[Symbol, Symbol]],
                    filter_expr: Optional[RowExpression]):
+        build_dfs = []
+        if self.dynamic_filtering:
+            from .dynamic_filter import plan_dynamic_filters
+
+            # register BEFORE visiting the probe side so its TableScan
+            # picks the filters up; the build pipeline runs first, so
+            # domains are complete before the first probe page scans
+            build_dfs = plan_dynamic_filters(self, left, criteria,
+                                             join_type)
         bops, blayout, btypes = self.visit(right)
         pops, playout, ptypes = self.visit(left)
 
@@ -201,7 +219,9 @@ class LocalExecutionPlanner:
         bridge = JoinBridge()
         bops.append(HashBuilderOperator(
             btypes, build_keys, bridge,
-            memory_context=self._mem_ctx("join-build")))
+            memory_context=self._mem_ctx("join-build"),
+            dynamic_filters=[(blayout[rs.name], df)
+                             for rs, df in build_dfs]))
         self.pipelines.append(PhysicalPipeline(bops))
 
         filter_fn = None
@@ -218,7 +238,8 @@ class LocalExecutionPlanner:
             filter_fn = proc.process
 
         pops.append(LookupJoinOperator(ptypes, probe_keys, bridge,
-                                       join_type, filter_fn))
+                                       join_type, filter_fn,
+                                       max_lanes=self.join_max_lanes))
         if join_type in ("semi", "anti"):
             out_layout = dict(playout)
             out_types = ptypes
@@ -424,7 +445,8 @@ class LocalExecutionPlanner:
             memory_context=self._mem_ctx("setop-build")))
         self.pipelines.append(PhysicalPipeline(bops))
         pchans = [playout[s.name] for s in left.output_symbols]
-        pops.append(LookupJoinOperator(ptypes, pchans, bridge, join_type))
+        pops.append(LookupJoinOperator(ptypes, pchans, bridge, join_type,
+                                       max_lanes=self.join_max_lanes))
         # distinct over the probe columns; output channels follow pchans
         # order, i.e. channel j <-> left.output_symbols[j] <-> symbols[j]
         pops.append(HashAggregationOperator(

@@ -62,9 +62,14 @@ class TableScanOperator(SourceOperator):
     """Pulls pages from connector page sources and uploads them to device
     (reference: operator/TableScanOperator.java)."""
 
-    def __init__(self, connector: Connector, columns: Sequence[ColumnHandle]):
+    def __init__(self, connector: Connector, columns: Sequence[ColumnHandle],
+                 dynamic_filters: Sequence = ()):
         self.connector = connector
         self.columns = list(columns)
+        # [(channel, DynamicFilter)] — join build-side domains applied to
+        # every scanned page as a lane-mask update (reference analog:
+        # dynamic-filter TupleDomains pushed into ConnectorPageSource)
+        self.dynamic_filters = list(dynamic_filters)
         self._splits: List[ConnectorSplit] = []
         self._source = None
         self._no_more_splits = False
@@ -97,7 +102,13 @@ class TableScanOperator(SourceOperator):
                 return None
             if page.num_rows == 0:
                 continue
-            return DevicePage.from_page(page)
+            dp = DevicePage.from_page(page)
+            for ch, df in self.dynamic_filters:
+                dp = DevicePage(dp.types, dp.cols, dp.nulls,
+                                df.apply(dp.cols[ch], dp.nulls[ch],
+                                         dp.valid),
+                                dp.dictionaries)
+            return dp
 
     def is_finished(self) -> bool:
         return self._done

@@ -17,6 +17,7 @@ from __future__ import annotations
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
+from .. import session_properties as SP
 from .. import types as T
 from ..block import Page
 from ..connectors.spi import Connector
@@ -182,7 +183,11 @@ class DistributedQueryRunner:
                 self.metadata, self.desired_splits, task_id=t,
                 task_count=ntasks,
                 exchange_reader=self._make_reader(buffers, t),
-                memory_pool=self._memory_pool)
+                memory_pool=self._memory_pool,
+                join_max_lanes=SP.value(self.session,
+                                        "join_max_expand_lanes"),
+                dynamic_filtering=SP.value(
+                    self.session, "enable_dynamic_filtering"))
             ops, layout, types_ = planner.visit(frag.root)
             # consumers map RemoteSourceNode symbols positionally, so the
             # wire layout MUST be output_symbols order — project if the
@@ -227,7 +232,11 @@ class DistributedQueryRunner:
                 self.metadata, self.desired_splits, task_id=t,
                 task_count=ntasks,
                 exchange_reader=self._make_reader(buffers, t),
-                memory_pool=self._memory_pool)
+                memory_pool=self._memory_pool,
+                join_max_lanes=SP.value(self.session,
+                                        "join_max_expand_lanes"),
+                dynamic_filtering=SP.value(
+                    self.session, "enable_dynamic_filtering"))
             plan = planner.plan(OutputNode(frag.root, root.column_names,
                                            root.outputs))
             results[t] = plan.execute()

@@ -123,18 +123,18 @@ class LocalQueryRunner:
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt)
         root = self.plan_statement(stmt)
-        from .exec.memory import pool_from_session
-
-        pool = pool_from_session(self.session)
-        local = LocalExecutionPlanner(self.metadata, self._splits(),
-                                      memory_pool=pool)
+        local = self._make_local_planner()
         plan = local.plan(root)
         pages = plan.execute()
         rows: List[tuple] = []
         for p in pages:
             rows.extend(p.to_rows())
+        stats = {"memory": local.memory_pool.stats()}
+        if local.dynamic_filters:
+            stats["dynamic_filters"] = [df.stats()
+                                        for df in local.dynamic_filters]
         return QueryResult(plan.column_names, plan.output_types, rows,
-                           stats={"memory": pool.stats()})
+                           stats=stats)
 
     def _splits(self) -> int:
         from . import session_properties as SP
@@ -143,6 +143,25 @@ class LocalQueryRunner:
             return SP.value(self.session, "desired_splits")
         return self.desired_splits
 
+    def _join_lanes(self) -> int:
+        from . import session_properties as SP
+
+        return SP.value(self.session, "join_max_expand_lanes")
+
+    def _make_local_planner(self) -> LocalExecutionPlanner:
+        """Session-configured planner: ALL execution paths (execute,
+        EXPLAIN ANALYZE, the DELETE rewrite) must honor the same
+        session knobs."""
+        from . import session_properties as SP
+        from .exec.memory import pool_from_session
+
+        return LocalExecutionPlanner(
+            self.metadata, self._splits(),
+            memory_pool=pool_from_session(self.session),
+            join_max_lanes=self._join_lanes(),
+            dynamic_filtering=SP.value(self.session,
+                                       "enable_dynamic_filtering"))
+
     def _explain_analyze(self, stmt: ast.Statement) -> QueryResult:
         """Run the query collecting per-operator stats, render the plan
         + stats (reference: operator/ExplainAnalyzeOperator.java +
@@ -150,11 +169,8 @@ class LocalQueryRunner:
         import time as _time
 
         root = self.plan_statement(stmt)
-        from .exec.memory import pool_from_session
-
-        pool = pool_from_session(self.session)
-        local = LocalExecutionPlanner(self.metadata, self._splits(),
-                                      memory_pool=pool)
+        local = self._make_local_planner()
+        pool = local.memory_pool
         plan = local.plan(root)
         t0 = _time.perf_counter()
         pages = plan.execute(collect_stats=True)
@@ -249,10 +265,5 @@ class LocalQueryRunner:
     def _collect_pages(self, sql: str) -> List[Page]:
         stmt = parse_statement(sql)
         root = self.plan_statement(stmt)
-        from .exec.memory import pool_from_session
-
-        local = LocalExecutionPlanner(self.metadata, self._splits(),
-                                      memory_pool=pool_from_session(
-                                          self.session))
-        plan = local.plan(root)
+        plan = self._make_local_planner().plan(root)
         return plan.execute()

@@ -61,6 +61,15 @@ register(SessionProperty(
     "spill_enabled", "boolean", False,
     "Spill aggregation/join state to host on memory pressure"))
 register(SessionProperty(
+    "enable_dynamic_filtering", "boolean", True,
+    "Prune probe-side scans with join build-side key domains "
+    "(min/max + small value sets)"))
+register(SessionProperty(
+    "join_max_expand_lanes", "integer", 1 << 20,
+    "Candidate-pair lanes per join-probe kernel launch; larger probe "
+    "pages split in half recursively to stay under this bound",
+    lambda v: v >= 1024))
+register(SessionProperty(
     "device_exchange", "boolean", True,
     "Run hash exchanges between co-resident stages as an all_to_all "
     "device collective over the mesh (falls back to the host path when "
