@@ -1,0 +1,100 @@
+"""Client protocol: POST /v1/statement + nextUri paging + CLI.
+
+Reference analog: TestQueryResource / the StatementClientV1 polling
+contract — submit, follow nextUri, typed JSON rows, error propagation.
+"""
+
+import pytest
+
+from trino_tpu.client import Client
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.server.protocol import ProtocolServer
+from trino_tpu.sql.analyzer import Session
+from trino_tpu.types import TrinoError
+
+
+@pytest.fixture(scope="module")
+def server():
+    runner = LocalQueryRunner({"tpch": TpchConnector(page_rows=4096)},
+                              Session(catalog="tpch", schema="micro"))
+    srv = ProtocolServer(runner, page_size=10).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture(scope="module")
+def client(server):
+    return Client(server.uri)
+
+
+def test_simple_query(client):
+    res = client.execute("select count(*) c from orders")
+    assert res.column_names == ["c"]
+    assert res.rows == [[1500]]
+    assert res.stats["state"] == "FINISHED"
+
+
+def test_typed_values(client):
+    res = client.execute(
+        "select o_orderdate, o_totalprice from orders "
+        "where o_orderkey = 1")
+    [[date, price]] = res.rows
+    assert isinstance(date, str) and date.count("-") == 2  # ISO date
+    assert isinstance(price, str)  # decimals travel as strings
+    assert res.columns[0]["type"] == "date"
+
+
+def test_next_uri_paging(client):
+    # page_size=10 forces multiple nextUri hops for 25 nations
+    res = client.execute("select n_name from nation order by n_name")
+    assert len(res.rows) == 25
+    assert res.rows == sorted(res.rows)
+
+
+def test_error_propagates(client):
+    with pytest.raises(TrinoError) as exc:
+        client.execute("select no_such_column from orders")
+    assert "no_such_column" in str(exc.value)
+
+
+def test_final_stats_exposed(client):
+    res = client.execute(
+        "select l_returnflag, count(*) from lineitem group by 1")
+    assert "memory" in res.stats
+    assert res.stats["memory"]["peak_bytes"] > 0
+
+
+def test_session_statements(client):
+    res = client.execute("show session")
+    names = [r[0] for r in res.rows]
+    assert "enable_dynamic_filtering" in names
+
+
+def test_cli_embedded(capsys):
+    from trino_tpu.cli import main
+
+    rc = main(["--embedded", "--catalog", "tpch", "--schema", "micro",
+               "-e", "select count(*) c from region"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "c" in out and "5" in out and "(1 row)" in out
+
+
+def test_cli_against_server(server, capsys):
+    from trino_tpu.cli import main
+
+    rc = main(["--server", server.uri,
+               "-e", "select 1 one, 'x' tag"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "one" in out and "tag" in out
+
+
+def test_info_endpoints(server):
+    import json
+    import urllib.request
+
+    with urllib.request.urlopen(server.uri + "/v1/info") as r:
+        info = json.loads(r.read())
+    assert info["coordinator"] is True

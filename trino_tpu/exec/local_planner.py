@@ -457,6 +457,23 @@ class LocalExecutionPlanner:
         return pops, layout, out_types
 
 
+def project_to_wire_layout(frag, ops, layout, types_):
+    """Append the projection fixing a fragment's WIRE layout: consumers
+    map RemoteSourceNode symbols positionally, so the output operator
+    must see output_symbols order exactly.  Shared by every runner that
+    builds a fragment's output tail (in-process, worker process).
+    Returns (ops, layout, types_, key_channels)."""
+    out_syms = frag.output_symbols
+    want = [layout[s.name] for s in out_syms]
+    if want != list(range(len(types_))):
+        proj = [InputRef(types_[c], c) for c in want]
+        ops.append(FilterProjectOperator(PageProcessor(types_, proj)))
+        types_ = [types_[c] for c in want]
+        layout = {s.name: i for i, s in enumerate(out_syms)}
+    key_channels = [layout[s.name] for s in frag.output_keys]
+    return ops, layout, types_, key_channels
+
+
 def _sort_keys(orderings, layout) -> List[SortKey]:
     keys = []
     for o in orderings:

@@ -189,22 +189,10 @@ class DistributedQueryRunner:
                 dynamic_filtering=SP.value(
                     self.session, "enable_dynamic_filtering"))
             ops, layout, types_ = planner.visit(frag.root)
-            # consumers map RemoteSourceNode symbols positionally, so the
-            # wire layout MUST be output_symbols order — project if the
-            # physical layout differs (ADVICE r1: was only an invariant)
-            out_syms = frag.output_symbols
-            want = [layout[s.name] for s in out_syms]
-            if want != list(range(len(types_))):
-                from ..expr.compiler import PageProcessor
-                from ..expr.ir import InputRef
-                from ..ops.operator import FilterProjectOperator
+            from ..exec.local_planner import project_to_wire_layout
 
-                proj = [InputRef(types_[c], c) for c in want]
-                ops.append(FilterProjectOperator(
-                    PageProcessor(types_, proj)))
-                types_ = [types_[c] for c in want]
-                layout = {s.name: i for i, s in enumerate(out_syms)}
-            key_channels = [layout[s.name] for s in frag.output_keys]
+            ops, layout, types_, key_channels = project_to_wire_layout(
+                frag, ops, layout, types_)
             if device_ex is not None:
                 from .device_exchange import DeviceExchangeSinkOperator
 

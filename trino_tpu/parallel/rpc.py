@@ -1,0 +1,71 @@
+"""Tiny framed RPC for the multi-process runtime.
+
+Reference analog: the airlift HTTP client/server carrying JSON task
+requests (``server/remotetask/HttpRemoteTask.java:599-623``) and
+octet-stream page results (``server/TaskResource.java:308``).  Here the
+control plane is length-prefixed pickled dicts over localhost TCP and
+the data plane is the serde page frames — same pull-based shape, minimal
+transport.  Pickle is acceptable because workers are processes WE spawn
+on this host (the reference's intra-cluster trust model); the external
+client protocol (HTTP + JSON) is a separate layer.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any
+
+
+def send_msg(sock: socket.socket, obj: Any):
+    blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<I", len(blob)) + blob)
+
+
+def recv_msg(sock: socket.socket) -> Any:
+    header = _recv_exact(sock, 4)
+    (n,) = struct.unpack("<I", header)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def send_frame(sock: socket.socket, blob: bytes):
+    sock.sendall(struct.pack("<I", len(blob)) + blob)
+
+
+def recv_frame(sock: socket.socket) -> bytes:
+    (n,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return _recv_exact(sock, n)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-frame")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def call(addr, request: dict, timeout: float = 600.0) -> dict:
+    """One request/response round trip on a fresh connection."""
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        send_msg(sock, request)
+        return recv_msg(sock)
+
+
+def fetch_pages(addr, task_id: str, partition: int,
+                deserializer, timeout: float = 600.0):
+    """Pull one task's partition: returns a list of Pages."""
+    with socket.create_connection(addr, timeout=timeout) as sock:
+        send_msg(sock, {"op": "get_results", "task_id": task_id,
+                        "partition": partition})
+        head = recv_msg(sock)
+        if head.get("error"):
+            raise RuntimeError(f"worker get_results failed: "
+                               f"{head['error']}")
+        pages = []
+        for _ in range(head["n_pages"]):
+            pages.append(deserializer.deserialize(recv_frame(sock)))
+        return pages

@@ -1,0 +1,212 @@
+"""Worker process: runs fragment tasks, buffers output, serves pulls.
+
+Reference analog: the worker half of the engine — ``SqlTaskManager``
+(``execution/SqlTaskManager.java:446`` applying TaskUpdateRequests),
+task execution (``SqlTaskExecution.java``), and the result endpoint
+(``server/TaskResource.java:308`` ``GET .../results/{bufferId}``).
+One process per worker, CPU-pinned JAX (the TPU chip belongs to the
+in-process mesh path; the process runtime exists to exercise the real
+coordinator/worker architecture: RPC, serde, pull-based shuffle,
+failure handling).
+
+Protocol (rpc.py framing; one request per connection):
+  configure     {catalogs, properties}            -> {ok}
+  run_task      {task_id, fragment, task_index, task_count,
+                 output_kind, n_partitions, upstream, session,
+                 inject_failure?}                 -> {ok|error, rows}
+  get_results   {task_id, partition}              -> header + page frames
+  release_task  {task_id}                         -> {ok}
+  ping          {}                                -> {ok, tasks}
+  shutdown      {}                                -> {ok} (then exits)
+"""
+
+from __future__ import annotations
+
+import os
+import socketserver
+import sys
+import threading
+import traceback
+from typing import Dict, List
+
+from .rpc import recv_msg, send_frame, send_msg
+
+
+class _TaskState:
+    def __init__(self):
+        self.status = "running"
+        self.error = None
+        self.buffer = None          # ops.output.OutputBuffer
+        self.rows = 0
+
+
+class WorkerServer:
+    def __init__(self, port: int = 0):
+        self.tasks: Dict[str, _TaskState] = {}
+        self.connectors = {}
+        self.properties: dict = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = recv_msg(self.request)
+                except ConnectionError:
+                    return
+                try:
+                    outer.dispatch(self.request, req)
+                except Exception as e:  # report, never kill the server
+                    traceback.print_exc()
+                    try:
+                        send_msg(self.request, {"error": repr(e)})
+                    except OSError:
+                        pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server(("127.0.0.1", port), Handler)
+        self.port = self.server.server_address[1]
+
+    # ------------------------------------------------------------------
+
+    def dispatch(self, sock, req: dict):
+        op = req.get("op")
+        if op == "configure":
+            from ..connectors.catalog import create_catalogs
+
+            self.connectors = create_catalogs(req["catalogs"])
+            self.properties = dict(req.get("properties", {}))
+            send_msg(sock, {"ok": True})
+        elif op == "run_task":
+            send_msg(sock, self.run_task(req))
+        elif op == "get_results":
+            self.send_results(sock, req["task_id"], req["partition"])
+        elif op == "release_task":
+            with self._lock:
+                self.tasks.pop(req["task_id"], None)
+            send_msg(sock, {"ok": True})
+        elif op == "ping":
+            send_msg(sock, {"ok": True, "pid": os.getpid(),
+                            "tasks": len(self.tasks)})
+        elif op == "shutdown":
+            send_msg(sock, {"ok": True})
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
+        else:
+            send_msg(sock, {"error": f"unknown op {op!r}"})
+
+    # ------------------------------------------------------------------
+
+    def run_task(self, req: dict) -> dict:
+        task_id = req["task_id"]
+        state = _TaskState()
+        with self._lock:
+            self.tasks[task_id] = state
+        try:
+            if req.get("inject_failure"):
+                # reference: execution/FailureInjector.java:40 — typed
+                # error injected at task execution for FT tests
+                raise RuntimeError(
+                    f"injected failure for task {task_id}")
+            state.rows = self._execute_fragment(req, state)
+            state.status = "finished"
+            return {"ok": True, "rows": state.rows}
+        except Exception as e:
+            state.status = "failed"
+            state.error = repr(e)
+            traceback.print_exc()
+            return {"error": state.error, "task_id": task_id}
+
+    def _execute_fragment(self, req: dict, state: _TaskState) -> int:
+        from ..exec.driver import Driver
+        from ..exec.local_planner import (LocalExecutionPlanner,
+                                          PhysicalPipeline)
+        from ..exec.serde import PageDeserializer
+        from ..ops.output import OutputBuffer, PartitionedOutputOperator
+        from ..planner.logical_planner import Metadata
+        from .rpc import fetch_pages
+
+        frag = req["fragment"]
+        upstream: Dict[int, dict] = req["upstream"]
+        task_index = req["task_index"]
+
+        def exchange_reader(fragment_id: int, kind: str):
+            src = upstream[fragment_id]
+            part = 0 if src["kind"] in ("single", "broadcast") \
+                else task_index
+
+            def thunk():
+                pages: List = []
+                for addr, up_task in src["locations"]:
+                    de = PageDeserializer()
+                    pages.extend(fetch_pages(tuple(addr), up_task, part,
+                                             de))
+                return pages
+
+            return thunk
+
+        session_props = req.get("session", {})
+        metadata = Metadata(self.connectors)
+        planner = LocalExecutionPlanner(
+            metadata, req.get("desired_splits", 8),
+            task_id=task_index, task_count=req["task_count"],
+            exchange_reader=exchange_reader,
+            join_max_lanes=session_props.get("join_max_expand_lanes"),
+            dynamic_filtering=session_props.get(
+                "enable_dynamic_filtering", True))
+        from ..exec.local_planner import project_to_wire_layout
+
+        ops, layout, types_ = planner.visit(frag.root)
+        ops, layout, types_, key_channels = project_to_wire_layout(
+            frag, ops, layout, types_)
+        buffer = OutputBuffer(
+            1 if frag.output_kind == "single" else req["n_partitions"],
+            broadcast=frag.output_kind == "broadcast")
+        ops.append(PartitionedOutputOperator(types_, key_channels, buffer,
+                                             frag.output_kind))
+        planner.pipelines.append(PhysicalPipeline(ops))
+        for p in planner.pipelines:
+            Driver(p.operators).run_to_completion()
+        state.buffer = buffer
+        return buffer.total_rows
+
+    # ------------------------------------------------------------------
+
+    def send_results(self, sock, task_id: str, partition: int):
+        from ..exec.serde import PageSerializer
+
+        with self._lock:
+            state = self.tasks.get(task_id)
+        if state is None or state.status != "finished":
+            send_msg(sock, {"error": f"task {task_id} not finished "
+                            f"({'missing' if state is None else state.status})"})
+            return
+        pages = state.buffer.pages(partition)
+        send_msg(sock, {"n_pages": len(pages)})
+        ser = PageSerializer()
+        for p in pages:
+            send_frame(sock, ser.serialize(p))
+
+    def serve_forever(self):
+        self.server.serve_forever()
+
+
+def main():
+    # workers are CPU-pinned: the TPU chip belongs to the in-process
+    # mesh path; this runtime validates the process architecture
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    port = int(sys.argv[1]) if len(sys.argv) > 1 else 0
+    server = WorkerServer(port)
+    print(f"WORKER_READY {server.port}", flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,213 @@
+"""HTTP client protocol: POST /v1/statement + nextUri paging.
+
+Reference analog: ``dispatcher/QueuedStatementResource.java:154-219``
+(query submission, queued nextUri hops) and ``server/protocol/
+ExecutingStatementResource.java:73,160`` (result paging), serving the
+same JSON document shape ``client/trino-client/.../StatementClientV1``
+polls: ``{id, columns, data, nextUri, stats, error}``.
+
+Implementation: stdlib ThreadingHTTPServer over any engine runner
+(LocalQueryRunner / DistributedQueryRunner / ProcessQueryRunner — they
+share the execute() surface).  Queries run on a small executor;
+results page out ``page_size`` rows per GET with token-sequenced
+nextUris; abandoned queries (no poll within ``query_ttl``) are evicted
+so disconnected clients cannot pin materialized results.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import threading
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from decimal import Decimal
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+from .. import types as T
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def _json_value(v, type_: T.Type):
+    if v is None:
+        return None
+    if isinstance(v, Decimal):
+        return str(v)
+    if type_ == T.DATE and isinstance(v, int):
+        return (EPOCH + datetime.timedelta(days=v)).isoformat()
+    return v
+
+
+class _QueryState:
+    def __init__(self, qid: str):
+        import time
+
+        self.id = qid
+        self.state = "QUEUED"
+        self.error: Optional[dict] = None
+        self.result = None
+        self.created = time.time()
+        self.last_poll = self.created
+
+
+class ProtocolServer:
+    """The coordinator's client-facing HTTP surface."""
+
+    def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
+                 page_size: int = 1000, query_ttl: float = 3600.0):
+        self.runner = runner
+        self.page_size = page_size
+        self.query_ttl = query_ttl
+        self.queries: Dict[str, _QueryState] = {}
+        self.executor = ThreadPoolExecutor(max_workers=4)
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def _reply(self, code: int, doc: dict):
+                body = json.dumps(doc).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                if self.path != "/v1/statement":
+                    self._reply(404, {"error": "not found"})
+                    return
+                n = int(self.headers.get("Content-Length", 0))
+                sql = self.rfile.read(n).decode()
+                self._reply(200, outer.submit(sql))
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                # /v1/statement/executing/{id}/{token}
+                if len(parts) == 5 and parts[:3] == \
+                        ["v1", "statement", "executing"]:
+                    self._reply(200, outer.poll(parts[3], int(parts[4])))
+                elif self.path == "/v1/info":
+                    self._reply(200, {"nodeVersion":
+                                      {"version": "trino-tpu-0.3"},
+                                      "coordinator": True,
+                                      "starting": False})
+                elif self.path == "/v1/status":
+                    self._reply(200, {"nodeId": "coordinator",
+                                      "state": "ACTIVE"})
+                else:
+                    self._reply(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) >= 4 and parts[:3] == \
+                        ["v1", "statement", "executing"]:
+                    outer.cancel(parts[3])
+                    # 204: no body allowed on a keep-alive connection
+                    self.send_response(204)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                else:
+                    self._reply(404, {"error": "not found"})
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.addr = self.httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+
+    @property
+    def uri(self) -> str:
+        return f"http://{self.addr[0]}:{self.addr[1]}"
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.executor.shutdown(wait=False)
+
+    # ------------------------------------------------------------------
+
+    def _evict_abandoned(self):
+        """Drop finished queries no client polled within query_ttl —
+        abandoned clients must not pin materialized results forever."""
+        import time
+
+        now = time.time()
+        for qid, q in list(self.queries.items()):
+            if now - q.last_poll > self.query_ttl:
+                self.queries.pop(qid, None)
+
+    def submit(self, sql: str) -> dict:
+        self._evict_abandoned()
+        qid = uuid.uuid4().hex[:16]
+        q = _QueryState(qid)
+        self.queries[qid] = q
+
+        def run():
+            q.state = "RUNNING"
+            try:
+                q.result = self.runner.execute(sql)
+                q.state = "FINISHED"
+            except Exception as e:
+                q.error = {
+                    "message": str(e),
+                    "errorCode": getattr(e, "code", "GENERIC_INTERNAL_ERROR"),
+                    "errorType": type(e).__name__,
+                }
+                q.state = "FAILED"
+
+        self.executor.submit(run)
+        return {
+            "id": qid,
+            "nextUri": f"{self.uri}/v1/statement/executing/{qid}/0",
+            "stats": {"state": q.state},
+        }
+
+    def poll(self, qid: str, token: int) -> dict:
+        q = self.queries.get(qid)
+        if q is None:
+            return {"error": {"message": f"unknown query {qid}",
+                              "errorCode": "NOT_FOUND"}}
+        import time
+
+        q.last_poll = time.time()
+        doc: dict = {"id": qid, "stats": {"state": q.state}}
+        if q.state in ("QUEUED", "RUNNING"):
+            doc["nextUri"] = \
+                f"{self.uri}/v1/statement/executing/{qid}/{token}"
+            return doc
+        if q.state == "FAILED":
+            doc["error"] = q.error
+            return doc
+        res = q.result
+        doc["columns"] = [{"name": n, "type": str(t)}
+                          for n, t in zip(res.column_names, res.types)]
+        start = token * self.page_size
+        chunk = res.rows[start:start + self.page_size]
+        doc["data"] = [[_json_value(v, t)
+                        for v, t in zip(row, res.types)]
+                       for row in chunk]
+        if start + self.page_size < len(res.rows):
+            doc["nextUri"] = \
+                f"{self.uri}/v1/statement/executing/{qid}/{token + 1}"
+        else:
+            if res.stats:
+                doc["stats"]["memory"] = res.stats.get("memory")
+                if "dynamic_filters" in res.stats:
+                    doc["stats"]["dynamicFilters"] = \
+                        res.stats["dynamic_filters"]
+            self.queries.pop(qid, None)  # final page delivered
+        return doc
+
+    def cancel(self, qid: str):
+        self.queries.pop(qid, None)
