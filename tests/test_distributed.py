@@ -74,6 +74,16 @@ def test_string_group_keys(local, dist):
           "group by l_shipmode order by l_shipmode")
 
 
+def test_full_outer_join(local, dist):
+    # FULL forces co-partitioned distribution; unmatched rows from both
+    # sides must appear exactly once across workers
+    check(local, dist, """
+        select o_custkey, c_custkey
+        from (select o_custkey from orders where o_custkey < 100) o
+        full outer join customer c
+        on o_custkey = c_custkey""")
+
+
 def test_broadcast_join(local, dist):
     check(local, dist,
           "select n_name, count(*) c from customer, nation "

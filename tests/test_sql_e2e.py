@@ -85,6 +85,55 @@ def test_left_join(runner):
     assert sum(1 for v in by_name.values() if v is None) == 3
 
 
+def test_full_outer_join(runner):
+    rows = q(runner, """
+        select t.x, t.y, u.a, u.b
+        from (values (1, 'p'), (2, 'q'), (3, 'r')) t(x, y)
+        full outer join (values (2, 'B'), (3, 'C'), (4, 'D')) u(a, b)
+        on t.x = u.a
+        order by coalesce(t.x, u.a)""")
+    assert rows == [(1, "p", None, None), (2, "q", 2, "B"),
+                    (3, "r", 3, "C"), (None, None, 4, "D")]
+
+
+def test_full_outer_join_residual(runner):
+    # the residual ON conjunct unmatches BOTH sides of the x=3 pair
+    rows = q(runner, """
+        select t.x, u.a
+        from (values (1), (2), (3)) t(x)
+        full outer join (values (2), (3), (4)) u(a)
+        on t.x = u.a and t.x < 3
+        order by coalesce(t.x, a), coalesce(a, t.x)""")
+    assert rows == [(1, None), (2, 2), (3, None),
+                    (None, 3), (None, 4)]
+
+
+def test_full_outer_join_duplicates_and_nulls(runner):
+    # duplicate keys fan out; NULL keys never match but still emit
+    rows = q(runner, """
+        select t.x, u.a
+        from (values (1), (1), (cast(null as integer))) t(x)
+        full outer join (values (1), (cast(null as integer))) u(a)
+        on t.x = u.a
+        order by coalesce(t.x, -1), coalesce(u.a, -1)""")
+    assert rows == [(None, None), (None, None),
+                    (1, 1), (1, 1)]
+
+
+def test_full_outer_join_tpch(runner):
+    # region 4 (MIDDLE EAST) keeps its row even when the nation subquery
+    # excludes it; the extra nation-side group keeps its row too
+    rows = q(runner, """
+        select r_name, c from region full outer join (
+            select n_regionkey, count(*) c from nation
+            where n_nationkey < 3 group by n_regionkey) x
+        on r_regionkey = n_regionkey
+        order by coalesce(r_regionkey, n_regionkey)""")
+    assert len(rows) == 5
+    by_name = dict(rows)
+    assert sum(1 for v in by_name.values() if v is None) == 3
+
+
 def test_order_limit_offset(runner):
     rows = q(runner, "select n_nationkey from nation "
                      "order by n_nationkey limit 3")

@@ -88,13 +88,15 @@ def _hash_combine(ops):
 
 @jax.jit
 def _build_sorted(key_u64, anynull, cols, nulls, valid):
-    """Sort the build rows by key; null-key or invalid lanes sort last."""
+    """Sort the build rows by key; null-key or invalid lanes sort last.
+    ``valid`` rides along so FULL OUTER can emit unmatched build rows
+    (including null-key rows, which are never ``usable``)."""
     usable = valid & ~anynull if anynull is not None else valid
     sort_key = jnp.where(usable, key_u64, np.uint64(0xFFFFFFFFFFFFFFFF))
-    operands = [sort_key, usable] + list(cols) + list(nulls)
+    operands = [sort_key, usable, valid] + list(cols) + list(nulls)
     s = jax.lax.sort(operands, num_keys=1, is_stable=False)
     n = len(cols)
-    return s[0], s[1], tuple(s[2:2 + n]), tuple(s[2 + n:])
+    return s[0], s[1], s[2], tuple(s[3:3 + n]), tuple(s[3 + n:])
 
 
 @jax.jit
@@ -124,6 +126,7 @@ def _expand_matches(lo, count, out_cap: int):
 class BuildSide:
     key_sorted: "jax.Array"
     usable_sorted: "jax.Array"
+    valid_sorted: "jax.Array"
     cols: Tuple
     nulls: Tuple
     types: List
@@ -259,17 +262,17 @@ class HashBuilderOperator(Operator):
             mode = choose_key_mode(bits, 2)
         key, anynull = _key_u64([cols[c] for c in kc],
                                 [nulls[c] for c in kc], key_types, mode)
-        ks, us, scols, snulls = _build_sorted(
+        ks, us, vs, scols, snulls = _build_sorted(
             key, anynull if anynull is not None
             else jnp.zeros(cap, dtype=bool), tuple(cols), tuple(nulls),
             valid)
-        self.bridge.set_build(BuildSide(ks, us, scols, snulls,
+        self.bridge.set_build(BuildSide(ks, us, vs, scols, snulls,
                                         self.input_types, dicts, kc, mode))
         self._pages = []  # release the input pages; only the index remains
         if self._ctx is not None:
             # retain only the published index: sorted key (8B) + usable
-            # (1B) + per-channel data/null lanes
-            retained = cap * (9 + sum(c.dtype.itemsize + 1 for c in scols))
+            # + valid (1B each) + per-channel data/null lanes
+            retained = cap * (10 + sum(c.dtype.itemsize + 1 for c in scols))
             self._ctx.close()
             self._ctx.reserve(retained, revocable=False)
             self.bridge.release = self._ctx.close
@@ -284,12 +287,16 @@ class HashBuilderOperator(Operator):
 
 
 class LookupJoinOperator(Operator):
-    """Probe side. join_type: inner | left | semi | anti.
+    """Probe side. join_type: inner | left | full | semi | anti.
 
-    Output layout: all probe channels, then (inner/left) all build channels
-    — build channels NULL on unmatched left rows. semi/anti emit probe
-    channels only.
-    """
+    Output layout: all probe channels, then (inner/left/full) all build
+    channels — build channels NULL on unmatched left rows. semi/anti emit
+    probe channels only. FULL OUTER additionally OR-accumulates a
+    matched flag per (sorted) build row across all probe pages and, once
+    the probe side finishes, emits one final page of unmatched build rows
+    with NULL probe channels (reference: LookupJoinOperator's
+    OuterLookupSource / buildOuter position iterator,
+    operator/join/LookupJoinOperator.java:36)."""
 
     #: bound on candidate-expansion lanes per kernel launch: a probe page
     #: whose total match count pads beyond this is sliced into contiguous
@@ -304,7 +311,7 @@ class LookupJoinOperator(Operator):
                  probe_key_channels: Sequence[int], bridge: JoinBridge,
                  join_type: str = "inner",
                  filter_fn=None, max_lanes: Optional[int] = None):
-        assert join_type in ("inner", "left", "semi", "anti")
+        assert join_type in ("inner", "left", "full", "semi", "anti")
         self.probe_types = list(probe_types)
         self.probe_keys = list(probe_key_channels)
         self.bridge = bridge
@@ -314,6 +321,13 @@ class LookupJoinOperator(Operator):
             self.max_lanes = max_lanes
         self._work: List = []  # prepared (page, pusable, lo, count, total)
         self._done = False
+        # FULL OUTER state: per-sorted-build-row matched flag (device,
+        # cap+1 lanes — the last is the dead-lane sink) + the dictionary
+        # pools of the last probe page (the unmatched-build page's probe
+        # channels are all-NULL, but string channels still need a pool)
+        self._build_matched = None
+        self._probe_dicts = None
+        self._emitted_unmatched = False
 
     @property
     def output_types(self) -> List[T.Type]:
@@ -332,10 +346,32 @@ class LookupJoinOperator(Operator):
         if self._work:
             return self._join_page(*self._work.pop(0))
         if self._finishing:
+            if self.join_type == "full" and not self._emitted_unmatched:
+                self._emitted_unmatched = True
+                return self._unmatched_build_page()
             if not self._done:
                 self.bridge.destroy()
             self._done = True
         return None
+
+    def _unmatched_build_page(self) -> DevicePage:
+        """FULL OUTER tail: build rows no kept lane ever matched, with
+        all probe channels NULL."""
+        from ..block import Dictionary
+
+        b = self.bridge.build
+        cap = int(b.valid_sorted.shape[0])
+        unmatched = b.valid_sorted if self._build_matched is None \
+            else b.valid_sorted & ~self._build_matched[:cap]
+        pcols = [jnp.zeros(cap, dtype=t.storage) for t in self.probe_types]
+        pnulls = [jnp.ones(cap, dtype=bool) for _ in self.probe_types]
+        pdicts = self._probe_dicts
+        if pdicts is None:
+            pdicts = [Dictionary() if t.is_string else None
+                      for t in self.probe_types]
+        return DevicePage(self.output_types, pcols + list(b.cols),
+                          pnulls + list(b.nulls), unmatched,
+                          list(pdicts) + list(b.dictionaries))
 
     def is_finished(self) -> bool:
         return self._done
@@ -428,11 +464,18 @@ class LookupJoinOperator(Operator):
             # failing it make the probe row unmatched, not dropped
             lanes = _gather_lanes(page, b, probe_idx, build_idx, keep)
             keep = self.filter_fn(lanes).valid
+        if self.join_type == "full":
+            bcap = int(b.valid_sorted.shape[0])
+            if self._build_matched is None:
+                self._build_matched = jnp.zeros(bcap + 1, dtype=bool)
+            self._build_matched = _mark_build_matched(
+                self._build_matched, keep, build_idx)
+            self._probe_dicts = page.dictionaries
         out_cols, out_nulls, out_valid = _finalize_join(
             tuple(page.cols), tuple(page.nulls), page.valid,
             tuple(b.cols), tuple(b.nulls),
             probe_idx, build_idx, keep,
-            left=self.join_type == "left")
+            left=self.join_type in ("left", "full"))
         types = self.output_types
         dicts = list(page.dictionaries) + list(b.dictionaries)
         return DevicePage(types, list(out_cols), list(out_nulls),
@@ -489,6 +532,14 @@ def _expand_verified(lo, count, pkey_cols, bkey_cols, out_cap: int):
     for pc, bc in zip(pkey_cols, bkey_cols):
         keep = keep & (pc[probe_idx] == bc[build_idx])
     return probe_idx, build_idx, keep
+
+
+@jax.jit
+def _mark_build_matched(acc, keep, build_idx):
+    """OR kept lanes into the per-sorted-build-row matched accumulator
+    (last lane of ``acc`` is the dead-lane sink)."""
+    sink = acc.shape[0] - 1
+    return acc.at[jnp.where(keep, build_idx, sink)].max(True)
 
 
 @partial(jax.jit, static_argnames=("probe_cap",))

@@ -153,7 +153,16 @@ class ExchangePlanner:
         rkeys = [r for _, r in node.criteria]
 
         right_rows = self._est._base_rows(node.right)
-        if self.join_distribution == "BROADCAST":
+        if node.join_type == "full":
+            # broadcast would emit each unmatched build row once PER
+            # probe task; FULL must co-partition both sides on the join
+            # keys (or collapse to a single task)
+            if ldist in (SINGLE, ANY):
+                right = self._to_single(right, rdist)
+                return JoinNode(node.join_type, left, right, node.criteria,
+                                node.filter_expr), SINGLE
+            partitioned = True
+        elif self.join_distribution == "BROADCAST":
             partitioned = False
         elif self.join_distribution == "PARTITIONED":
             partitioned = bool(node.criteria) and ldist not in (SINGLE, ANY)

@@ -395,10 +395,14 @@ class QueryPlanner:
             lnode, rnode = rnode, lnode
             criteria = [(r, l) for l, r in criteria]
             jt = "LEFT"
-        if jt == "FULL":
-            raise AnalysisError("FULL OUTER JOIN not supported yet")
-
-        join_type = "inner" if jt == "INNER" else "left"
+        join_type = {"INNER": "inner", "LEFT": "left",
+                     "FULL": "full"}.get(jt, "left")
+        if jt == "FULL" and not criteria:
+            # a FULL join whose ON clause has no equi-conjunct has no
+            # partitionable key; the engine's sorted-index join needs one
+            raise AnalysisError(
+                "FULL OUTER JOIN requires at least one equality "
+                "conjunct in ON")
         if not criteria and join_type == "inner":
             node: PlanNode = CrossJoinNode(lnode, rnode)
             if residual:

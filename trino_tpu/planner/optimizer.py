@@ -63,11 +63,13 @@ class Optimizer:
             return self._build_join_region(node, preds)
 
         if isinstance(node, JoinNode):
-            # left/semi/anti: push left-only conjuncts into the probe side
+            # left/semi/anti: push left-only conjuncts into the probe
+            # side. FULL null-extends BOTH sides, so nothing may cross it.
             left_syms = {s.name for s in node.left.output_symbols}
             push_left, stay = [], []
             for p in preds:
-                (push_left if referenced_symbols(p) <= left_syms
+                (push_left if node.join_type != "full"
+                 and referenced_symbols(p) <= left_syms
                  else stay).append(p)
             left = self.push_filters(node.left, push_left)
             right = self.push_filters(node.right, [])
