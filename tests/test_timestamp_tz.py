@@ -1,0 +1,136 @@
+"""TIMESTAMP WITH TIME ZONE: literals, AT TIME ZONE, casts, DST-aware
+arithmetic, instant-semantics grouping, wire serde.
+
+Reference analog: ``spi/type/TimestampWithTimeZoneType.java`` +
+``type/TestTimestampWithTimeZone.java``. The TPU design stores UTC
+micros on device with the zone as column metadata (see expr/tz.py).
+"""
+
+import datetime
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.expr import tz
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=4096)},
+                            Session(catalog="tpch", schema="micro"))
+
+
+def one(runner, sql):
+    rows = runner.execute(sql).rows
+    assert len(rows) == 1
+    return rows[0]
+
+
+def test_tzif_offsets():
+    jul = int(datetime.datetime(
+        2020, 7, 1, tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+    jan = int(datetime.datetime(
+        2020, 1, 15, tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+    assert tz.offset_at("America/New_York", jul) == -4 * 3600 * 1_000_000
+    assert tz.offset_at("America/New_York", jan) == -5 * 3600 * 1_000_000
+    assert tz.parse_fixed_offset_micros("+05:30") == 19800 * 1_000_000
+
+
+def test_literal_named_zone(runner):
+    (v,) = one(runner,
+               "select timestamp '2020-01-15 10:00:00 America/New_York'")
+    assert v.year == 2020 and v.hour == 10
+    assert v.utcoffset() == datetime.timedelta(hours=-5)
+
+
+def test_literal_fixed_offset(runner):
+    (v,) = one(runner, "select timestamp '2020-01-15 10:00:00 +02:00'")
+    assert v.hour == 10
+    assert v.utcoffset() == datetime.timedelta(hours=2)
+
+
+def test_at_time_zone(runner):
+    # session zone is UTC: 10:00 UTC == 05:00 EST
+    (v,) = one(runner, "select timestamp '2020-01-15 10:00:00' "
+                       "AT TIME ZONE 'America/New_York'")
+    assert (v.hour, v.minute) == (5, 0)
+    assert v.utcoffset() == datetime.timedelta(hours=-5)
+
+
+def test_cast_to_timestamp_wall_clock(runner):
+    (v,) = one(runner, "select cast(timestamp "
+                       "'2020-07-15 12:00:00 America/New_York' "
+                       "as timestamp)")
+    # wall clock preserved: 2020-07-15T12:00:00 in micros
+    assert v == 1594814400000000
+
+
+def test_extract_uses_wall_clock(runner):
+    y, d = one(runner,
+               "select extract(year from ts), extract(day from ts) from "
+               "(values timestamp '2020-12-31 23:00:00 -05:00') t(ts)")
+    assert (y, d) == (2020, 31)
+
+
+def test_interval_day_is_instant_arithmetic(runner):
+    # +2 days across the US spring-forward gap: 48 real hours
+    (v,) = one(runner, "select timestamp "
+                       "'2020-03-07 12:00:00 America/New_York' "
+                       "+ interval '2' day")
+    assert (v.month, v.day, v.hour) == (3, 9, 13)
+
+
+def test_group_by_instant_semantics(runner):
+    # same instant in two zones lands in ONE group
+    rows = runner.execute(
+        "select count(*) from (values "
+        "timestamp '2020-01-01 00:00:00 UTC', "
+        "timestamp '2019-12-31 19:00:00 -05:00') t(x) group by x").rows
+    assert rows == [(2,)]
+
+
+def test_order_by_instant(runner):
+    rows = runner.execute(
+        "select x from (values "
+        "timestamp '2020-01-01 12:00:00 +09:00', "
+        "timestamp '2020-01-01 12:00:00 UTC', "
+        "timestamp '2020-01-01 12:00:00 -05:00') t(x) order by x").rows
+    instants = [v.timestamp() for (v,) in rows]
+    assert instants == sorted(instants)
+
+
+def test_wire_serde_preserves_zone():
+    from trino_tpu.block import Block, Page
+    from trino_tpu.exec.serde import PageDeserializer, PageSerializer
+
+    t = T.timestamp_tz_type("America/New_York")
+    page = Page([Block.from_pylist(t, [0, 1_600_000_000_000_000, None])], 3)
+    frame = PageSerializer().serialize(page)
+    out = PageDeserializer().deserialize(frame)
+    assert out.blocks[0].type.is_timestamp_tz
+    assert out.blocks[0].type.zone == "America/New_York"
+    assert out.to_rows()[1][0].utcoffset() == datetime.timedelta(hours=-4)
+
+
+def test_current_timestamp_is_tz(runner):
+    (v,) = one(runner, "select current_timestamp")
+    assert isinstance(v, datetime.datetime)
+    assert v.tzinfo is not None
+
+
+def test_create_table_with_tz_column(runner):
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    r = LocalQueryRunner(
+        {"mem": MemoryConnector(), "tpch": TpchConnector(page_rows=512)},
+        Session(catalog="mem", schema="default"))
+    r.execute("create table events (id bigint, "
+              "at timestamp(6) with time zone)")
+    r.execute("insert into events values "
+              "(1, timestamp '2020-06-01 08:00:00 +01:00')")
+    rows = r.execute("select id, at from events").rows
+    assert rows[0][0] == 1
+    assert rows[0][1].utcoffset() is not None

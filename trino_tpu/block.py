@@ -180,6 +180,23 @@ class Block:
             raw = b.dictionary.decode(data)
         elif t.is_decimal:
             raw = [t.from_raw(v) for v in data.tolist()]
+        elif t.is_timestamp_tz:
+            # zone-aware datetimes: the user-visible form carries the
+            # column's rendering zone (device raw is the UTC instant)
+            import datetime as _dt
+
+            from .expr.tz import parse_fixed_offset_micros
+
+            fixed = parse_fixed_offset_micros(t.zone)
+            if fixed is None:
+                from zoneinfo import ZoneInfo
+
+                tzinfo = ZoneInfo(t.zone)
+            else:
+                tzinfo = _dt.timezone(_dt.timedelta(microseconds=fixed))
+            epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+            raw = [(epoch + _dt.timedelta(microseconds=int(v)))
+                   .astimezone(tzinfo) for v in data.tolist()]
         elif t == T.BOOLEAN:
             raw = [bool(v) for v in data]
         elif t in (T.DOUBLE, T.REAL):
@@ -201,11 +218,18 @@ class Block:
             data = d.encode(values)
             return Block(type_, data, nulls if has_nulls else None, d)
         data = np.empty(n, dtype=type_.storage)
+        if type_.is_timestamp_tz:
+            import datetime as _dt
+
+            epoch = _dt.datetime(1970, 1, 1, tzinfo=_dt.timezone.utc)
+            one_us = _dt.timedelta(microseconds=1)
         for i, v in enumerate(values):
             if v is None:
                 data[i] = 0
             elif type_.is_decimal:
                 data[i] = type_.to_raw(v)
+            elif type_.is_timestamp_tz and hasattr(v, "timestamp"):
+                data[i] = (v - epoch) // one_us
             else:
                 data[i] = v
         return Block(type_, data, nulls if has_nulls else None)

@@ -26,6 +26,20 @@ from ..block import Block, Dictionary, Page
 _MAGIC = 0x54505047  # "TPPG"
 
 
+def _wire_signature(t: T.Type) -> str:
+    """Type -> wire string. TIMESTAMP WITH TIME ZONE carries its zone
+    (case-sensitive) in brackets; ``parse_type`` alone would drop it."""
+    if t.is_timestamp_tz:
+        return f"timestamptz[{t.zone}]"
+    return str(t)
+
+
+def _parse_wire_signature(sig: str) -> T.Type:
+    if sig.startswith("timestamptz[") and sig.endswith("]"):
+        return T.timestamp_tz_type(sig[len("timestamptz["):-1])
+    return T.parse_type(sig)
+
+
 class PageSerializer:
     """One serializer per output stream (per consumer); tracks which
     dictionary pools were already shipped on each channel."""
@@ -40,7 +54,7 @@ class PageSerializer:
                                           page.channel_count)]
         for ch, b in enumerate(page.blocks):
             b = b.numpy()
-            sig = str(b.type).encode()
+            sig = _wire_signature(b.type).encode()
             flags = 0
             dict_payload = b""
             if b.dictionary is not None:
@@ -118,7 +132,7 @@ class PageDeserializer:
             off += 3
             sig = raw[off:off + sig_len].decode()
             off += sig_len
-            type_ = T.parse_type(sig)
+            type_ = _parse_wire_signature(sig)
             dictionary: Optional[Dictionary] = None
             if flags & 2:
                 pool_id, sent_len, n_delta = struct.unpack_from(

@@ -547,6 +547,24 @@ class PageProcessor:
 
         def ev(env):
             r, n = p(env)
+            if st.is_timestamp_tz or rt.is_timestamp_tz:
+                from .tz import device_utc_to_wall, device_wall_to_utc
+
+                day_us = np.int64(86_400_000_000)
+                if st.is_timestamp_tz and rt.is_timestamp_tz:
+                    return r, n  # same instant; zone is type metadata
+                if st.is_timestamp_tz and rt == T.TIMESTAMP:
+                    return device_utc_to_wall(r, st.zone), n
+                if st.is_timestamp_tz and rt == T.DATE:
+                    wall = device_utc_to_wall(r, st.zone)
+                    return jnp.floor_divide(wall, day_us) \
+                        .astype(jnp.int32), n
+                if st == T.TIMESTAMP and rt.is_timestamp_tz:
+                    # wall clock interpreted in the target's zone
+                    return device_wall_to_utc(r, rt.zone), n
+                if st == T.DATE and rt.is_timestamp_tz:
+                    wall = r.astype(jnp.int64) * day_us
+                    return device_wall_to_utc(wall, rt.zone), n
             if st == T.DATE and rt == T.TIMESTAMP:
                 return r.astype(jnp.int64) * np.int64(86_400_000_000), n
             if st == T.TIMESTAMP and rt == T.DATE:

@@ -126,12 +126,12 @@ def _resolve_add_sub(args):
         s = max(da.scale, db.scale)
         p = min(18, max(da.precision - da.scale, db.precision - db.scale) + s + 1)
         return T.decimal_type(p, s)
-    # date/timestamp +- interval
-    if a in (T.DATE, T.TIMESTAMP) and b in (T.INTERVAL_DAY_SECOND,
-                                            T.INTERVAL_YEAR_MONTH):
+    # date/timestamp[tz] +- interval
+    if (a in (T.DATE, T.TIMESTAMP) or a.is_timestamp_tz) \
+            and b in (T.INTERVAL_DAY_SECOND, T.INTERVAL_YEAR_MONTH):
         return a
-    if b in (T.DATE, T.TIMESTAMP) and a in (T.INTERVAL_DAY_SECOND,
-                                            T.INTERVAL_YEAR_MONTH):
+    if (b in (T.DATE, T.TIMESTAMP) or b.is_timestamp_tz) \
+            and a in (T.INTERVAL_DAY_SECOND, T.INTERVAL_YEAR_MONTH):
         return b
     raise TypeError_(f"cannot add/subtract {a} and {b}")
 
@@ -177,8 +177,24 @@ def _add_sub_kernel(sign):
     def kernel(raws, arg_types, ret_type):
         a, b = raws
         ta, tb = arg_types
-        if tb in (T.DATE, T.TIMESTAMP):  # interval + date => date + interval
+        if tb in (T.DATE, T.TIMESTAMP) or tb.is_timestamp_tz:
+            # interval + date => date + interval
             a, b, ta, tb = b, a, tb, ta
+        if ta.is_timestamp_tz and tb in (T.INTERVAL_DAY_SECOND,
+                                         T.INTERVAL_YEAR_MONTH):
+            if tb == T.INTERVAL_DAY_SECOND:
+                return a + sign * b  # instant arithmetic
+            # year-month intervals add in WALL time (reference:
+            # TimestampWithTimeZoneOperators) — convert, add, convert back
+            from .tz import device_utc_to_wall, device_wall_to_utc
+
+            wall = device_utc_to_wall(a, ta.zone)
+            days = _date_plus_interval(
+                (wall // np.int64(86_400_000_000)).astype(jnp.int32),
+                b, tb, sign)
+            new_wall = days.astype(jnp.int64) * np.int64(86_400_000_000) \
+                + wall % np.int64(86_400_000_000)
+            return device_wall_to_utc(new_wall, ta.zone)
         if ta in (T.DATE, T.TIMESTAMP) and tb in (T.INTERVAL_DAY_SECOND,
                                                   T.INTERVAL_YEAR_MONTH):
             if ta == T.TIMESTAMP:
@@ -546,12 +562,18 @@ def days_from_civil_host(y: int, m: int, d: int) -> int:
 
 def _resolve_date_part(args):
     (a,) = args
-    if a in (T.DATE, T.TIMESTAMP):
+    if a in (T.DATE, T.TIMESTAMP) or a.is_timestamp_tz:
         return T.BIGINT
     raise TypeError_(f"expected date/timestamp, got {a}")
 
 
 def _to_days(raw, t):
+    if t.is_timestamp_tz:
+        from .tz import device_utc_to_wall
+
+        wall = device_utc_to_wall(raw, t.zone)
+        return jnp.floor_divide(
+            wall, np.int64(86_400_000_000)).astype(jnp.int32)
     if t == T.TIMESTAMP:
         return jnp.floor_divide(raw, np.int64(86_400_000_000)).astype(jnp.int32)
     return raw

@@ -37,6 +37,8 @@ def _json_value(v, type_: T.Type):
         return str(v)
     if type_ == T.DATE and isinstance(v, int):
         return (EPOCH + datetime.timedelta(days=v)).isoformat()
+    if isinstance(v, datetime.datetime):  # timestamp with time zone
+        return v.isoformat()
     return v
 
 

@@ -14,6 +14,7 @@ Symbols; parent scopes give correlated subqueries access to outer columns
 from __future__ import annotations
 
 import datetime as _dt
+import re
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -99,6 +100,7 @@ class Session:
     schema: str = "tiny"
     start_date: _dt.date = field(default_factory=_dt.date.today)
     properties: Dict[str, str] = field(default_factory=dict)
+    timezone: str = "UTC"
 
 
 def coerce(expr: RowExpression, target: T.Type) -> RowExpression:
@@ -257,7 +259,18 @@ class ExpressionAnalyzer:
             y, m, d = map(int, e.value.split("-"))
             return Literal(T.DATE, days_from_civil_host(y, m, d))
         if tn == "timestamp":
-            return Literal(T.TIMESTAMP, _parse_timestamp_micros(e.value))
+            dtpart, zone = _split_timestamp_zone(e.value)
+            try:
+                wall = _parse_timestamp_micros(dtpart)
+                if zone is None:
+                    return Literal(T.TIMESTAMP, wall)
+                from ..expr.tz import wall_to_utc_host
+
+                utc = wall_to_utc_host(wall, zone)
+            except ValueError as ex:
+                raise AnalysisError(
+                    f"invalid timestamp literal '{e.value}': {ex}")
+            return Literal(T.timestamp_tz_type(zone), utc)
         if tn in ("decimal", "numeric"):
             return self._an_DecimalLiteral(ast.DecimalLiteral(e.value))
         if tn == "char":
@@ -405,7 +418,36 @@ class ExpressionAnalyzer:
         days = days_from_civil_host(d.year, d.month, d.day)
         if e.kind == "current_date":
             return Literal(T.DATE, days)
-        return Literal(T.TIMESTAMP, days * 86_400_000_000)
+        # current_timestamp is TIMESTAMP WITH TIME ZONE in the session
+        # zone (reference: SystemSessionProperties start-time semantics);
+        # deterministic at midnight of start_date
+        from ..expr.tz import wall_to_utc_host
+
+        zone = getattr(self.session, "timezone", "UTC") or "UTC"
+        utc = wall_to_utc_host(days * 86_400_000_000, zone)
+        return Literal(T.timestamp_tz_type(zone), utc)
+
+    def _an_AtTimeZone(self, e):
+        from ..expr import tz as _tz
+
+        try:
+            _tz.utc_offset_table(e.zone)  # validate the zone early
+        except ValueError as ex:
+            raise AnalysisError(str(ex))
+        v = self.analyze(e.value)
+        if v.type.is_timestamp_tz:
+            # same instant, different rendering zone: a type-only change
+            return Call(T.timestamp_tz_type(e.zone), "$cast", (v,))
+        if v.type in (T.TIMESTAMP, T.DATE):
+            # wall clock interpreted in the SESSION zone, rendered in the
+            # requested zone (reference: AtTimeZone semantics)
+            sess = T.timestamp_tz_type(
+                getattr(self.session, "timezone", "UTC") or "UTC")
+            as_ts = coerce(v, T.TIMESTAMP)
+            return Call(T.timestamp_tz_type(e.zone), "$cast",
+                        (Call(sess, "$cast", (as_ts,)),))
+        raise AnalysisError(
+            f"AT TIME ZONE requires a timestamp, got {v.type}")
 
     def _an_SearchedCase(self, e):
         whens = [(coerce(self.analyze(w.condition), T.BOOLEAN),
@@ -512,7 +554,25 @@ class ExpressionAnalyzer:
         return self.subquery_hook(self, e)
 
 
+_TS_ZONE_RE = re.compile(
+    r"^(\d{4}-\d{2}-\d{2}(?:[ T]\d{1,2}:\d{2}(?::\d{2}(?:\.\d+)?)?)?)"
+    r"(?:\s+([A-Za-z][A-Za-z0-9_/+-]*(?:/[A-Za-z0-9_+-]+)*)"
+    r"|\s*([+-]\d{1,2}:\d{2}))?$")
+
+
+def _split_timestamp_zone(text: str):
+    """'2020-01-01 10:00:00 +02:00' -> (datetime part, zone or None)."""
+    m = _TS_ZONE_RE.match(text.strip())
+    if m is None:
+        return text, None
+    zone = m.group(2) or m.group(3)
+    return m.group(1), zone
+
+
 def _parse_timestamp_micros(text: str) -> int:
+    text = text.strip()
+    if len(text) > 10 and text[10] in ("T", "t"):  # ISO 'T' separator
+        text = text[:10] + " " + text[11:]
     date_part, _, time_part = text.partition(" ")
     y, m, d = map(int, date_part.split("-"))
     micros = days_from_civil_host(y, m, d) * 86_400_000_000

@@ -70,6 +70,14 @@ class GenericLiteral(Expression):
 
 
 @dataclass(frozen=True)
+class AtTimeZone(Expression):
+    """``value AT TIME ZONE 'zone'`` (reference: sql/tree/AtTimeZone.java)."""
+
+    value: Expression
+    zone: str
+
+
+@dataclass(frozen=True)
 class Identifier(Expression):
     name: str
 

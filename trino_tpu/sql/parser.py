@@ -304,6 +304,8 @@ class Parser:
                 self.advance()
             self.expect_op(")")
             parts.append("(" + ", ".join(args) + ")")
+        if parts[0] == "timestamp" and self._accept_with_time_zone():
+            parts.append(" with time zone")
         return "".join(parts)
 
     # -- queries -------------------------------------------------------
@@ -703,6 +705,20 @@ class Parser:
                 self.advance()
                 left = ast.FunctionCall("concat",
                                         (left, self._multiplicative()))
+            elif (self.tok.kind == "ident" and self.tok.value == "at"
+                  and self.peek().kind == "kw"
+                  and self.peek().value == "time"
+                  and self.peek(2).kind == "ident"
+                  and self.peek(2).value == "zone"):
+                # AT TIME ZONE ('at'/'zone' stay soft: plain identifiers)
+                self.advance()
+                self.advance()
+                self.advance()
+                if self.tok.kind != "string":
+                    raise ParseError(
+                        "AT TIME ZONE expects a zone string literal at "
+                        f"position {self.tok.pos}", self.tok.pos)
+                left = ast.AtTimeZone(left, self.advance().value)
             else:
                 return left
 
@@ -965,16 +981,30 @@ class Parser:
         return f"{n} {d.upper()}"
 
     def _type_name(self) -> str:
-        parts = [self.identifier() if self.tok.kind == "ident"
-                 else self.advance().value]
+        base = self.identifier() if self.tok.kind == "ident" \
+            else self.advance().value
+        out = base
         if self.at_op("("):
             self.advance()
             params = [self.advance().value]
             while self.accept_op(","):
                 params.append(self.advance().value)
             self.expect_op(")")
-            return f"{parts[0]}({', '.join(params)})"
-        return parts[0]
+            out = f"{base}({', '.join(params)})"
+        if base == "timestamp" and self._accept_with_time_zone():
+            out += " with time zone"
+        return out
+
+    def _accept_with_time_zone(self) -> bool:
+        if self.at_kw("with") and self.peek().kind == "kw" \
+                and self.peek().value == "time" \
+                and self.peek(2).kind == "ident" \
+                and self.peek(2).value == "zone":
+            self.advance()
+            self.advance()
+            self.advance()
+            return True
+        return False
 
 
 def parse_statement(sql: str) -> ast.Statement:

@@ -58,6 +58,10 @@ class Type:
     def is_decimal(self) -> bool:
         return False
 
+    @property
+    def is_timestamp_tz(self) -> bool:
+        return False
+
     def zero(self):
         """Neutral raw storage value used for padding lanes."""
         return np.zeros((), dtype=self.storage)[()]
@@ -78,6 +82,35 @@ DOUBLE = Type("double", np.dtype(np.float64))
 DATE = Type("date", np.dtype(np.int32))
 # TIMESTAMP(6) = microseconds since epoch, no tz (spi/type/TimestampType.java)
 TIMESTAMP = Type("timestamp(6)", np.dtype(np.int64))
+
+
+@dataclass(frozen=True)
+class TimestampTZType(Type):
+    """TIMESTAMP(6) WITH TIME ZONE. Device storage is the **UTC instant**
+    in int64 micros (comparison/join/group-by are instant-ordered, the
+    reference's contract, ``spi/type/TimestampWithTimeZoneType.java``);
+    the zone rides on the type as column metadata and only matters for
+    wall-clock conversion (casts, EXTRACT, rendering) — evaluated on
+    device via a searchsorted over the zone's transition table
+    (``expr/tz.py``), not per-value host calls."""
+
+    zone: str = "UTC"
+
+    @property
+    def is_timestamp_tz(self) -> bool:
+        return True
+
+
+def timestamp_tz_type(zone: str = "UTC") -> TimestampTZType:
+    from .expr.tz import canonical_zone
+
+    return TimestampTZType(name="timestamp(6) with time zone",
+                           storage=np.dtype(np.int64),
+                           zone=canonical_zone(zone))
+
+
+TIMESTAMP_TZ = TimestampTZType(name="timestamp(6) with time zone",
+                               storage=np.dtype(np.int64), zone="UTC")
 # INTERVAL types: day-seconds as micros / months as int32
 INTERVAL_DAY_SECOND = Type("interval day to second", np.dtype(np.int64))
 INTERVAL_YEAR_MONTH = Type("interval year to month", np.dtype(np.int32))
@@ -214,6 +247,9 @@ _SIMPLE_TYPES = {
     "date": DATE,
     "timestamp": TIMESTAMP,
     "timestamp(6)": TIMESTAMP,
+    "timestamp with time zone": TIMESTAMP_TZ,
+    "timestamp(6) with time zone": TIMESTAMP_TZ,
+    "timestamptz": TIMESTAMP_TZ,
     "varchar": VARCHAR,
     "string": VARCHAR,
     "interval day to second": INTERVAL_DAY_SECOND,
@@ -225,9 +261,11 @@ _PARAM_RE = re.compile(r"^(\w+)\s*\(\s*(\d+)\s*(?:,\s*(\d+)\s*)?\)$")
 
 
 def parse_type(text: str) -> Type:
-    t = text.strip().lower()
+    t = re.sub(r"\s+", " ", text.strip().lower())
     if t in _SIMPLE_TYPES:
         return _SIMPLE_TYPES[t]
+    if t.endswith(" with time zone") and t.startswith("timestamp"):
+        return TIMESTAMP_TZ
     m = _PARAM_RE.match(t)
     if m:
         base, p1, p2 = m.group(1), int(m.group(2)), m.group(3)
@@ -284,6 +322,14 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         return _NUMERIC_LADDER[max(_NUMERIC_LADDER.index(a), _NUMERIC_LADDER.index(b))]
     if {a, b} == {DATE, TIMESTAMP}:
         return TIMESTAMP
+    if a.is_timestamp_tz and b.is_timestamp_tz:
+        # zones are per-column metadata; mixed zones meet at the left's
+        # (values are instants either way, so only rendering differs)
+        return a
+    if a.is_timestamp_tz and b in (DATE, TIMESTAMP):
+        return a
+    if b.is_timestamp_tz and a in (DATE, TIMESTAMP):
+        return b
     return None
 
 
