@@ -208,11 +208,8 @@ class LocalExecutionPlanner:
             build_keys = []
             probe_keys = []
             for lsym, rsym in criteria:
-                if lsym.type.is_string or rsym.type.is_string:
-                    raise TrinoError(
-                        "string equi-join keys not supported yet "
-                        "(dictionary unification pending)",
-                        "NOT_SUPPORTED")
+                # string keys are fine: the probe remaps its dictionary
+                # codes into the build's pool (LookupJoinOperator._remap)
                 probe_keys.append(playout[lsym.name])
                 build_keys.append(blayout[rsym.name])
 
@@ -381,7 +378,8 @@ class LocalExecutionPlanner:
             calls.append(WindowCall(
                 f.function, arg_ch,
                 f.argument.type if f.argument is not None else None,
-                out_sym.type, f.frame_mode, f.offset))
+                out_sym.type, f.frame_mode, f.offset,
+                f.frame_start, f.frame_end))
         ops.append(WindowOperator(types_, pchans, keys, calls))
         new_layout = dict(layout)
         out_types = list(types_)
@@ -430,11 +428,6 @@ class LocalExecutionPlanner:
         NULL keys as non-matching — NULL-row edge cases differ until the
         join gains IS NOT DISTINCT semantics."""
         left, right = node.inputs
-        ltypes = [s.type for s in node.symbols]
-        if any(t.is_string for t in ltypes):
-            raise TrinoError(
-                f"{join_type} set operation over varchar columns not "
-                "supported yet", "NOT_SUPPORTED")
         bops, blayout, btypes = self.visit(right)
         pops, playout, ptypes = self.visit(left)
         # align probe/build channel order to symbol order

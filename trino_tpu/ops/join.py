@@ -36,6 +36,19 @@ from .operator import Operator
 from .sortkeys import group_operands
 
 
+def _canonical_codes(codes, dictionary):
+    """Map dictionary codes to the FIRST code of their value, so equal
+    strings in an aligned (duplicate-valued) pool compare equal by code."""
+    if dictionary is None or len(dictionary) == 0:
+        return codes
+    canon = np.fromiter(
+        (dictionary.lookup(v) for v in dictionary.values),
+        dtype=np.int32, count=len(dictionary))
+    if (canon == np.arange(len(canon), dtype=np.int32)).all():
+        return codes  # already canonical (the common, dedup'd pool)
+    return jnp.asarray(canon)[codes]
+
+
 def _key_u64(cols, nulls, types_, mode: str) -> Tuple:
     """(key_u64, any_null): combined uint64 join key per row.
 
@@ -250,7 +263,20 @@ class HashBuilderOperator(Operator):
         for ch, df in self.dynamic_filters:
             df.collect(cols[ch], nulls[ch], valid)
         kc = self.key_channels
-        key_types = [self.input_types[c] for c in kc]
+        # string keys join on dictionary CODES in the build's pool: the
+        # build side uses its own codes as plain ints; the probe side
+        # remaps its codes into this pool (LookupJoinOperator._remap),
+        # so both sides feed _key_u64 the same integer key space.
+        # CANONICALIZE build key codes first: aligned pools (derived by
+        # string transforms) may map one value to several codes, and
+        # code-equality must mean value-equality for the join keys.
+        # Canonical codes decode to the same strings, so rewriting the
+        # stored column is output-safe.
+        for c in kc:
+            if self.input_types[c].is_string:
+                cols[c] = _canonical_codes(cols[c], dicts[c])
+        key_types = [T.BIGINT if self.input_types[c].is_string
+                     else self.input_types[c] for c in kc]
         mode = "single" if len(kc) == 1 else "hashed"
         if len(kc) == 2:
             # host decision (one sync at build publish): exact 32-bit pack?
@@ -319,7 +345,8 @@ class LookupJoinOperator(Operator):
         self.filter_fn = filter_fn  # optional post-join residual filter
         if max_lanes is not None:
             self.max_lanes = max_lanes
-        self._work: List = []  # prepared (page, pusable, lo, count, total)
+        # prepared work units: (page, pkey_cols, pusable, lo, count, total)
+        self._work: List = []
         self._done = False
         # FULL OUTER state: per-sorted-build-row matched flag (device,
         # cap+1 lanes — the last is the dead-lane sink) + the dictionary
@@ -328,6 +355,8 @@ class LookupJoinOperator(Operator):
         self._build_matched = None
         self._probe_dicts = None
         self._emitted_unmatched = False
+        # probe-dict -> build-dict code remap LUTs for string join keys
+        self._remap_cache: dict = {}
 
     @property
     def output_types(self) -> List[T.Type]:
@@ -376,6 +405,52 @@ class LookupJoinOperator(Operator):
     def is_finished(self) -> bool:
         return self._done
 
+    def _remap(self, probe_dict, build_dict):
+        """Probe-pool code -> build-pool code LUT (-1 = absent, matches
+        nothing; always canonical first-occurrence codes, so aligned
+        pools with duplicate values compare correctly). Host work once
+        per (probe pool, build pool) pair; the gather runs on device.
+        The cache entry pins both dict objects: bare id() keys would go
+        stale if a pool were GC'd and its address reused."""
+        key = (id(probe_dict), len(probe_dict) if probe_dict else 0,
+               id(build_dict), len(build_dict) if build_dict else 0)
+        hit = self._remap_cache.get(key)
+        if hit is not None:
+            return hit[0]
+        if build_dict is None:
+            lut = np.full(max(1, len(probe_dict or ())), -1,
+                          dtype=np.int64)
+        else:
+            lut = np.fromiter(
+                (build_dict.lookup(v) for v in probe_dict.values),
+                dtype=np.int64,
+                count=len(probe_dict)) if probe_dict and \
+                len(probe_dict) else np.full(1, -1, dtype=np.int64)
+        lut = jnp.asarray(lut)
+        if len(self._remap_cache) >= 128:  # evict BEFORE inserting
+            self._remap_cache.clear()
+        self._remap_cache[key] = (lut, probe_dict, build_dict)
+        return lut
+
+    def _probe_key_cols(self, page: DevicePage, b: "BuildSide"):
+        """Per key channel: the probe column transformed into the build's
+        key space (identity for non-strings; canonical code remap for
+        string keys — also when pools are shared, since an aligned pool
+        may hold duplicate values under distinct codes)."""
+        out = []
+        types_ = []
+        for i, c in enumerate(self.probe_keys):
+            t = self.probe_types[c]
+            if t.is_string:
+                pd = page.dictionaries[c]
+                bd = b.dictionaries[b.key_channels[i]]
+                out.append(self._remap(pd, bd)[page.cols[c]])
+                types_.append(T.BIGINT)
+            else:
+                out.append(page.cols[c])
+                types_.append(t)
+        return out, types_
+
     def _prepare(self, page: DevicePage) -> List:
         """Probe-count one page (keys + binary search computed ONCE) and
         slice it into work units whose expansions fit max_lanes; each
@@ -383,18 +458,22 @@ class LookupJoinOperator(Operator):
         b = self.bridge.build
         assert b is not None, "probe started before build finished"
         kc = self.probe_keys
-        pkey, panynull = _key_u64([page.cols[c] for c in kc],
+        pkey_cols, key_types = self._probe_key_cols(page, b)
+        pkey, panynull = _key_u64(pkey_cols,
                                   [page.nulls[c] for c in kc],
-                                  [self.probe_types[c] for c in kc],
-                                  b.key_mode)
+                                  key_types, b.key_mode)
         pusable = page.valid & ~panynull if panynull is not None \
             else page.valid
         lo, count = _probe_counts(b.key_sorted, b.usable_sorted, pkey,
                                   pusable)
-        counts = np.asarray(count)  # ONE device sync per probe page
-        total = int(counts.sum())
+        # ONE SCALAR sync per probe page — total match count picks the
+        # static expansion capacity (out_cap is a jit static arg, so a
+        # host value is unavoidable); the full per-row count vector only
+        # crosses to host on the rare over-budget chunking path
+        total = int(jnp.sum(count))
         if padded_size(max(total, 16)) <= self.max_lanes:
-            return [(page, pusable, lo, count, total)]
+            return [(page, pkey_cols, pusable, lo, count, total)]
+        counts = np.asarray(count)
         # greedy contiguous row chunks under the lane budget (a single
         # row exceeding it still becomes its own unit: out_cap grows to
         # its fan-out, which no slicing can avoid)
@@ -416,23 +495,23 @@ class LookupJoinOperator(Operator):
                              [_pad_dev(x[sl], cap) for x in page.nulls],
                              _pad_dev(page.valid[sl], cap),
                              page.dictionaries)
-            units.append((sub, _pad_dev(pusable[sl], cap),
+            units.append((sub, [_pad_dev(k[sl], cap) for k in pkey_cols],
+                          _pad_dev(pusable[sl], cap),
                           _pad_dev(lo[sl], cap), _pad_dev(count[sl], cap),
                           run))
             i = j
         return units
 
-    def _join_page(self, page: DevicePage, pusable, lo, count,
+    def _join_page(self, page: DevicePage, pkey_cols, pusable, lo, count,
                    total: int) -> DevicePage:
         b = self.bridge.build
-        kc = self.probe_keys
 
         if self.join_type in ("semi", "anti"):
             cap = padded_size(max(total, 16))
             if self.filter_fn is None:
                 matched = _semi_matched(
                     lo, count,
-                    tuple(page.cols[c] for c in kc),
+                    tuple(pkey_cols),
                     tuple(b.cols[c] for c in b.key_channels),
                     page.valid.shape[0], out_cap=cap)
             else:
@@ -442,7 +521,7 @@ class LookupJoinOperator(Operator):
                 # then segment-OR back onto probe rows
                 probe_idx, build_idx, keep = _expand_verified(
                     lo, count,
-                    tuple(page.cols[c] for c in kc),
+                    tuple(pkey_cols),
                     tuple(b.cols[c] for c in b.key_channels), out_cap=cap)
                 lanes = _gather_lanes(page, b, probe_idx, build_idx, keep)
                 matched = _segment_any(self.filter_fn(lanes).valid,
@@ -457,7 +536,7 @@ class LookupJoinOperator(Operator):
         lane_cap = padded_size(max(total, 16))
         probe_idx, build_idx, keep = _expand_verified(
             lo, count,
-            tuple(page.cols[c] for c in kc),
+            tuple(pkey_cols),
             tuple(b.cols[c] for c in b.key_channels), out_cap=lane_cap)
         if self.filter_fn is not None:
             # ON-clause residual runs BEFORE left-join padding: lanes
