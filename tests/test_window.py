@@ -106,3 +106,89 @@ def test_window_in_subquery_topn_pattern(runner):
             from nation) t
         where rn = 1 order by n_regionkey""")
     assert len(rows) == 5
+
+
+def _mem_runner():
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    mem = MemoryConnector()
+    r = LocalQueryRunner({"memory": mem},
+                         Session(catalog="memory", schema="default"))
+    r.execute("create table t (g bigint, v bigint)")
+    r.execute("insert into t values (1, 10), (1, 20), (1, 30), (1, 40), "
+              "(2, 5), (2, 6), (2, 7)")
+    return r
+
+
+def test_last_value_default_frame():
+    # default frame = RANGE UNBOUNDED..CURRENT: last_value is the
+    # current peer run's end, NOT the partition end
+    r = _mem_runner()
+    rows = q(r, """
+        select g, v, last_value(v) over (partition by g order by v) lv
+        from t order by g, v""")
+    assert [x[2] for x in rows] == [10, 20, 30, 40, 5, 6, 7]
+    rows = q(r, """
+        select g, v, last_value(v) over (partition by g order by v
+            rows between unbounded preceding and unbounded following) lv
+        from t order by g, v""")
+    assert [x[2] for x in rows] == [40, 40, 40, 40, 7, 7, 7]
+
+
+def test_nth_value():
+    r = _mem_runner()
+    rows = q(r, """
+        select g, v, nth_value(v, 2) over (partition by g order by v
+            rows between unbounded preceding and unbounded following) nv
+        from t order by g, v""")
+    assert [x[2] for x in rows] == [20, 20, 20, 20, 6, 6, 6]
+    # default running frame: nth row not yet in frame => NULL
+    rows = q(r, """
+        select g, v, nth_value(v, 3) over (partition by g order by v) nv
+        from t order by g, v""")
+    assert [x[2] for x in rows] == [None, None, 30, 30, None, None, 7]
+
+
+def test_bounded_rows_moving_sum_and_avg():
+    r = _mem_runner()
+    rows = q(r, """
+        select g, v,
+               sum(v) over (partition by g order by v
+                            rows between 1 preceding and 1 following) s,
+               count(*) over (partition by g order by v
+                              rows between 1 preceding and 1 following) c
+        from t order by g, v""")
+    assert [x[2] for x in rows] == [30, 60, 90, 70, 11, 18, 13]
+    assert [x[3] for x in rows] == [2, 3, 3, 2, 2, 3, 2]
+
+
+def test_bounded_rows_min_max():
+    r = _mem_runner()
+    rows = q(r, """
+        select g, v,
+               min(v) over (partition by g order by v
+                            rows between 2 preceding and current row) mn,
+               max(v) over (partition by g order by v
+                            rows between current row and 2 following) mx
+        from t order by g, v""")
+    assert [x[2] for x in rows] == [10, 10, 10, 20, 5, 5, 5]
+    assert [x[3] for x in rows] == [30, 40, 40, 40, 7, 7, 7]
+
+
+def test_preceding_to_unbounded_following():
+    r = _mem_runner()
+    rows = q(r, """
+        select g, v, sum(v) over (partition by g order by v
+            rows between 1 preceding and unbounded following) s
+        from t order by g, v""")
+    assert [x[2] for x in rows] == [100, 100, 90, 70, 18, 18, 13]
+
+
+def test_empty_frame_is_null():
+    r = _mem_runner()
+    rows = q(r, """
+        select g, v, sum(v) over (partition by g order by v
+            rows between 3 following and 4 following) s
+        from t order by g, v""")
+    assert [x[2] for x in rows] == [40, None, None, None, None, None,
+                                    None]

@@ -14,8 +14,11 @@ lane. No per-row loops, everything static-shape.
 
 Supported frames: full partition (no ORDER BY, or UNBOUNDED..UNBOUNDED),
 RANGE UNBOUNDED PRECEDING..CURRENT ROW (the SQL default with ORDER BY —
-peers included via run-end gather), and ROWS UNBOUNDED
-PRECEDING..CURRENT ROW.
+peers included via run-end gather), and ROWS frames with any bound
+combination (UNBOUNDED / CURRENT ROW / k PRECEDING / k FOLLOWING).
+Bounded-rows aggregates use prefix-difference for sum/count/avg and a
+doubling (sparse-table) range query for min/max — O(n log n) device work
+instead of per-row loops. RANGE with value offsets is not supported.
 """
 
 from __future__ import annotations
@@ -36,7 +39,7 @@ from .sort import _concat_pages
 from .sortkeys import SortKey, group_operands, sort_operands
 
 RANKING = {"row_number", "rank", "dense_rank", "ntile"}
-VALUE_FNS = {"lag", "lead", "first_value"}
+VALUE_FNS = {"lag", "lead", "first_value", "last_value", "nth_value"}
 AGG_FNS = {"count", "count_star", "sum", "avg", "min", "max"}
 
 
@@ -44,21 +47,27 @@ AGG_FNS = {"count", "count_star", "sum", "avg", "min", "max"}
 class WindowCall:
     """One window function over the operator's shared (partition, order)
     spec. ``frame_mode``: 'partition' (whole partition), 'range' (default
-    running frame incl. peers), 'rows' (running, exact rows)."""
+    running frame incl. peers), 'rows' (exact rows). For 'rows',
+    ``frame_start``/``frame_end`` are row offsets relative to the current
+    row (negative = PRECEDING, positive = FOLLOWING, 0 = CURRENT ROW,
+    None = UNBOUNDED); the default (None, 0) is the running frame."""
 
     function: str
     arg_channel: Optional[int]
     arg_type: Optional[T.Type]
     output_type: T.Type
     frame_mode: str = "range"
-    offset: int = 1          # lag/lead distance; ntile bucket count
+    offset: int = 1          # lag/lead distance; ntile buckets; nth n
+    frame_start: Optional[int] = None
+    frame_end: Optional[int] = 0
 
 
 def resolve_window_type(function: str, arg_type: Optional[T.Type]) -> T.Type:
     if function in ("row_number", "rank", "dense_rank", "ntile",
                     "count", "count_star"):
         return T.BIGINT
-    if function in ("lag", "lead", "first_value"):
+    if function in ("lag", "lead", "first_value", "last_value",
+                    "nth_value"):
         return arg_type
     if function == "sum":
         from .aggregation import resolve_agg_type
@@ -86,6 +95,41 @@ def _seg_scan(op, x, reset):
 
     _, out = jax.lax.associative_scan(combine, (reset, x))
     return out
+
+
+def _suffix_seg_scan(op, x, pend_flag):
+    """Segmented scan from each partition's END backwards: out[i] =
+    op-fold of x[i..partition_end]."""
+    xr = jnp.flip(x)
+    reset = jnp.flip(pend_flag)
+    return jnp.flip(_seg_scan(op, xr, reset))
+
+
+def _sparse_table(op, x):
+    """Stacked doubling tables: table[k, i] = op-fold of
+    x[i .. i + 2^k - 1] (clamped). O(n log n) build, O(1) range query —
+    the device replacement for per-row frame loops."""
+    n = x.shape[0]
+    levels = [x]
+    step = 1
+    while step < n:
+        prev = levels[-1]
+        shifted = prev[jnp.minimum(jnp.arange(n) + step, n - 1)]
+        levels.append(op(prev, shifted))
+        step *= 2
+    return jnp.stack(levels)
+
+
+def _range_query(table, op, lo, hi):
+    """op-fold of x[lo..hi] (lo <= hi assumed; caller masks empties) via
+    two overlapping power-of-two windows."""
+    length = jnp.maximum(hi - lo + 1, 1)
+    # float64 log2 is exact at powers of two, so floor() is safe
+    k = jnp.floor(jnp.log2(length.astype(jnp.float64))).astype(jnp.int32)
+    pow2 = jnp.int64(1) << k.astype(jnp.int64)
+    a = table[k, lo]
+    b = table[k, jnp.maximum(hi - pow2 + 1, lo)]
+    return op(a, b)
 
 
 @partial(jax.jit, static_argnames=("num_part_ops", "num_order_ops",
@@ -140,6 +184,22 @@ def _window_kernel(part_ops, order_ops, cols, nulls, valid,
     rend_idx = jnp.clip(rend_idx, 0, n - 1)
 
     row_number = idx - pstart_idx + 1
+
+    def frame_lo_hi(call):
+        """(lo, hi, empty) row-index frame bounds for one call. Python
+        branching on the (static) frame spec; device arrays out."""
+        if call.frame_mode == "partition":
+            return pstart_idx, pend_idx, jnp.zeros(n, dtype=bool)
+        if call.frame_mode == "range":
+            return pstart_idx, rend_idx, jnp.zeros(n, dtype=bool)
+        fs, fe = call.frame_start, call.frame_end
+        lo_raw = pstart_idx if fs is None else idx + fs
+        hi_raw = pend_idx if fe is None else idx + fe
+        lo = jnp.maximum(lo_raw, pstart_idx)
+        hi = jnp.minimum(hi_raw, pend_idx)
+        empty = lo > hi
+        return jnp.clip(lo, 0, n - 1), jnp.clip(hi, 0, n - 1), empty
+
     outs = []
     for call in calls:
         f = call.function
@@ -169,10 +229,19 @@ def _window_kernel(part_ops, order_ops, cols, nulls, valid,
             outs.append((jnp.where(in_part, x[src_c], x[src_c] * 0),
                          ~in_part | xn[src_c]))
             continue
-        if f == "first_value":
+        if f in ("first_value", "last_value", "nth_value"):
             x = s_cols[call.arg_channel]
             xn = s_nulls[call.arg_channel]
-            outs.append((x[pstart_idx], xn[pstart_idx]))
+            lo, hi, empty = frame_lo_hi(call)
+            if f == "first_value":
+                pos = lo
+            elif f == "last_value":
+                pos = hi
+            else:
+                pos = lo + (call.offset - 1)
+                empty = empty | (pos > hi)
+            pos = jnp.clip(pos, 0, n - 1)
+            outs.append((x[pos], empty | xn[pos]))
             continue
 
         # aggregates over the frame
@@ -197,27 +266,79 @@ def _window_kernel(part_ops, order_ops, cols, nulls, valid,
                     xval = jnp.where(live, x,
                                      jnp.asarray(sent, dtype=x.dtype))
 
-        cnt_scan = _seg_scan(jnp.add, live.astype(jnp.int64), pstart)
-        if f in ("count", "count_star"):
-            scan = cnt_scan
-        elif f in ("sum", "avg"):
-            scan = _seg_scan(jnp.add, xval, pstart)
-        elif f == "min":
-            scan = _seg_scan(jnp.minimum, xval, pstart)
-        else:
-            scan = _seg_scan(jnp.maximum, xval, pstart)
+        fs, fe = call.frame_start, call.frame_end
+        both_bounded = call.frame_mode == "rows" \
+            and fs is not None and fe is not None
+        start_bounded = call.frame_mode == "rows" and fs is not None
 
-        if call.frame_mode == "partition":
-            at = pend_idx
-        elif call.frame_mode == "range":
-            at = rend_idx
-        else:  # rows
-            at = idx
-        val = scan[at]
-        cnt = cnt_scan[at]
-        if f in ("count", "count_star"):
-            outs.append((val, None))
-        elif f == "avg":
+        if both_bounded:
+            # prefix-difference for additive fns; sparse-table range
+            # query for min/max (subtraction has no inverse there)
+            lo, hi, empty = frame_lo_hi(call)
+            pref_cnt = jnp.cumsum(live.astype(jnp.int64))
+            cnt = pref_cnt[hi] - jnp.where(lo > 0, pref_cnt[lo - 1], 0)
+            cnt = jnp.where(empty, 0, cnt)
+            if f in ("count", "count_star"):
+                outs.append((cnt, None))
+                continue
+            if f in ("sum", "avg"):
+                pref = jnp.cumsum(xval)
+                val = pref[hi] - jnp.where(lo > 0, pref[lo - 1],
+                                           jnp.zeros((), xval.dtype))
+                val = jnp.where(empty, jnp.zeros((), xval.dtype), val)
+            else:
+                op = jnp.minimum if f == "min" else jnp.maximum
+                val = _range_query(_sparse_table(op, xval), op, lo, hi)
+        elif start_bounded:
+            # k PRECEDING .. UNBOUNDED FOLLOWING: suffix scan at lo
+            lo, hi, empty = frame_lo_hi(call)
+            cnt_sfx = _suffix_seg_scan(jnp.add, live.astype(jnp.int64),
+                                       pend_flag)
+            cnt = jnp.where(empty, 0, cnt_sfx[lo])
+            if f in ("count", "count_star"):
+                outs.append((cnt, None))
+                continue
+            op = {"sum": jnp.add, "avg": jnp.add, "min": jnp.minimum,
+                  "max": jnp.maximum}[f]
+            sfx = _suffix_seg_scan(op, xval, pend_flag)
+            val = sfx[lo]
+            if f in ("sum", "avg"):
+                val = jnp.where(empty, jnp.zeros((), xval.dtype), val)
+        else:
+            # running frames: forward segmented scan read at the frame
+            # end (partition end / peer-run end / current row / +k rows)
+            cnt_scan = _seg_scan(jnp.add, live.astype(jnp.int64), pstart)
+            if f in ("count", "count_star"):
+                scan = cnt_scan
+            elif f in ("sum", "avg"):
+                scan = _seg_scan(jnp.add, xval, pstart)
+            elif f == "min":
+                scan = _seg_scan(jnp.minimum, xval, pstart)
+            else:
+                scan = _seg_scan(jnp.maximum, xval, pstart)
+
+            if call.frame_mode == "partition":
+                at = pend_idx
+                empty = jnp.zeros(n, dtype=bool)
+            elif call.frame_mode == "range":
+                at = rend_idx
+                empty = jnp.zeros(n, dtype=bool)
+            elif fe == 0:
+                at = idx
+                empty = jnp.zeros(n, dtype=bool)
+            else:  # UNBOUNDED PRECEDING .. k ROWS (k != 0)
+                hi_raw = idx + fe
+                empty = hi_raw < pstart_idx
+                at = jnp.clip(jnp.minimum(hi_raw, pend_idx), 0, n - 1)
+            val = scan[at]
+            cnt = jnp.where(empty, 0, cnt_scan[at])
+            if f in ("count", "count_star"):
+                outs.append((cnt, None))
+                continue
+            if f in ("sum", "avg"):
+                val = jnp.where(empty, jnp.zeros((), xval.dtype), val)
+
+        if f == "avg":
             if call.output_type.is_decimal:
                 from ..expr.functions import div_round_half_up
 

@@ -315,7 +315,11 @@ class WindowFunctionSpec:
     function: str
     argument: Optional[Symbol]
     frame_mode: str = "range"   # partition | range | rows
-    offset: int = 1             # lag/lead distance, ntile buckets
+    offset: int = 1             # lag/lead distance, ntile buckets, nth n
+    # ROWS frame bounds: row offsets vs current row (negative =
+    # PRECEDING, 0 = CURRENT ROW, None = UNBOUNDED)
+    frame_start: Optional[int] = None
+    frame_end: Optional[int] = 0
 
 
 @dataclass
