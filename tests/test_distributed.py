@@ -84,6 +84,24 @@ def test_full_outer_join(local, dist):
         on o_custkey = c_custkey""")
 
 
+def test_distributed_explain_analyze(dist):
+    res = dist.execute("""explain analyze
+        select n_regionkey, count(*) c from nation
+        group by n_regionkey order by c desc""")
+    text = "\n".join(r[0] for r in res.rows)
+    assert "Stage" in text and "task 0:" in text
+    assert "TableScanOperator" in text
+    tree = res.stats["query_stats"]
+    assert tree["stages"], tree
+    stage_ids = {s["stage_id"] for s in tree["stages"]}
+    assert len(stage_ids) >= 2  # source stage + final stage at least
+    for s in tree["stages"]:
+        assert s["tasks"], s
+        for t in s["tasks"]:
+            assert isinstance(t["wall_ms"], float)
+            assert t["operators"]
+
+
 def test_broadcast_join(local, dist):
     check(local, dist,
           "select n_name, count(*) c from customer, nation "

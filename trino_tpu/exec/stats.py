@@ -1,0 +1,111 @@
+"""Query/stage/task stats tree for distributed execution.
+
+Reference analog: ``execution/QueryStats.java`` / ``StageInfo`` /
+``TaskStats`` / ``OperatorStats`` — the hierarchy the coordinator
+aggregates from task status updates and serves on ``/v1/query/{id}``
+and through EXPLAIN ANALYZE.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .driver import OperatorStats
+
+
+@dataclass
+class TaskStatsTree:
+    task_id: int
+    operators: List[OperatorStats] = field(default_factory=list)
+
+    @property
+    def wall_ns(self) -> int:
+        return sum(o.wall_ns for o in self.operators)
+
+    @property
+    def output_rows(self) -> int:
+        # the tail operator is a sink (output buffer / collector): stage
+        # output = rows produced by the operator feeding it
+        if len(self.operators) >= 2:
+            return self.operators[-2].output_rows
+        return self.operators[-1].output_rows if self.operators else 0
+
+    def to_dict(self) -> dict:
+        return {
+            "task_id": self.task_id,
+            "wall_ms": round(self.wall_ns / 1e6, 2),
+            "operators": [
+                {"name": o.name, "rows": o.output_rows,
+                 "pages": o.output_pages,
+                 "wall_ms": round(o.wall_ns / 1e6, 2)}
+                for o in self.operators],
+        }
+
+
+@dataclass
+class StageStatsTree:
+    stage_id: int
+    partitioning: str
+    output_kind: str
+    tasks: List[TaskStatsTree] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "stage_id": self.stage_id,
+            "partitioning": self.partitioning,
+            "output_kind": self.output_kind,
+            "tasks": [t.to_dict() for t in self.tasks],
+        }
+
+
+@dataclass
+class QueryStatsTree:
+    stages: List[StageStatsTree] = field(default_factory=list)
+    wall_ms: float = 0.0
+    memory: Optional[Dict] = None
+
+    def to_dict(self) -> dict:
+        return {
+            "wall_ms": round(self.wall_ms, 2),
+            "memory": self.memory,
+            "stages": [s.to_dict() for s in self.stages],
+        }
+
+    def render(self) -> List[str]:
+        """EXPLAIN ANALYZE text: stages top-down with per-task operator
+        rows/pages/wall (reference: planprinter/PlanPrinter +
+        TextRenderer)."""
+        lines: List[str] = []
+        lines.append(f"Query: {self.wall_ms:.1f}ms")
+        if self.memory:
+            lines.append(
+                f"Memory: peak {self.memory.get('peak_bytes', 0)} bytes, "
+                f"{self.memory.get('spill_events', 0)} spills "
+                f"({self.memory.get('spilled_bytes', 0)} bytes)")
+        for s in sorted(self.stages, key=lambda s: -s.stage_id):
+            total_rows = sum(t.output_rows for t in s.tasks)
+            lines.append(
+                f"Stage {s.stage_id} [{s.partitioning} -> "
+                f"{s.output_kind}] {len(s.tasks)} tasks, "
+                f"{total_rows} rows out")
+            # aggregate the per-operator view across tasks (positional:
+            # every task of a stage runs the same operator chain)
+            agg: Dict[int, OperatorStats] = {}
+            for t in s.tasks:
+                for i, o in enumerate(t.operators):
+                    a = agg.get(i)
+                    if a is None:
+                        agg[i] = OperatorStats(o.name, o.output_rows,
+                                               o.output_pages, o.wall_ns)
+                    else:
+                        a.output_rows += o.output_rows
+                        a.output_pages += o.output_pages
+                        a.wall_ns += o.wall_ns
+            for i in sorted(agg):
+                lines.append("    " + agg[i].line())
+            for t in s.tasks:
+                lines.append(f"    task {t.task_id}: "
+                             f"{t.output_rows} rows, "
+                             f"{t.wall_ns / 1e6:.1f}ms")
+        return lines
