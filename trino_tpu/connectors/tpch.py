@@ -551,13 +551,71 @@ class TpchMetadata(ConnectorMetadata):
                 in enumerate(_TABLE_COLUMNS[table.table])]
 
     def get_statistics(self, table: TableHandle) -> TableStatistics:
+        """Row counts plus the per-column ndv / min-max the cost model
+        feeds on (reference: TpchMetadata.getTableStatistics serving
+        cost/ScanStatsRule). Values follow the generator's formulas."""
         sf = _SCHEMAS[table.schema]
+        c = _counts(sf)
         t = self.conn.table(table.table)
         rows = t.row_count(sf)
-        cols = {}
-        for cname, ctype in t.columns:
-            if cname.endswith("key"):
-                cols[cname] = ColumnStatistics(distinct_count=rows * 0.9)
+        cols: Dict[str, ColumnStatistics] = {}
+
+        def put(name, ndv=None, lo=None, hi=None):
+            cols[name] = ColumnStatistics(distinct_count=ndv,
+                                          min_value=lo, max_value=hi)
+
+        tb = table.table
+        if tb == "lineitem":
+            put("l_orderkey", c["orders"], 1, c["orders"])
+            put("l_partkey", c["part"], 1, c["part"])
+            put("l_suppkey", c["supplier"], 1, c["supplier"])
+            put("l_linenumber", 7, 1, 7)
+            # decimal columns: raw scaled units (cents for scale 2 —
+            # IR literals carry raw values)
+            put("l_quantity", 50, 100, 5000)
+            put("l_discount", 11, 0, 10)
+            put("l_tax", 9, 0, 8)
+            put("l_returnflag", 3)
+            put("l_linestatus", 2)
+            put("l_shipdate", 2526, _START + 1, _END)
+            put("l_commitdate", 2466, _START + 30, _END)
+            put("l_receiptdate", 2554, _START + 2, _END + 30)
+            put("l_shipmode", 7)
+            put("l_shipinstruct", 4)
+        elif tb == "orders":
+            put("o_orderkey", c["orders"], 1, c["orders"])
+            put("o_custkey", c["customer"] * 2 // 3, 1, c["customer"])
+            put("o_orderstatus", 3)
+            put("o_orderdate", _ORDER_DATE_SPAN, _START,
+                _START + _ORDER_DATE_SPAN)
+            put("o_orderpriority", 5)
+            put("o_shippriority", 1, 0, 0)
+        elif tb == "customer":
+            put("c_custkey", c["customer"], 1, c["customer"])
+            put("c_nationkey", 25, 0, 24)
+            put("c_mktsegment", 5)
+            put("c_acctbal", rows * 0.9, -99_999, 999_999)
+        elif tb == "supplier":
+            put("s_suppkey", c["supplier"], 1, c["supplier"])
+            put("s_nationkey", 25, 0, 24)
+            put("s_acctbal", rows * 0.9, -99_999, 999_999)
+        elif tb == "part":
+            put("p_partkey", c["part"], 1, c["part"])
+            put("p_size", 50, 1, 50)
+            put("p_brand", 25)
+            put("p_type", 150)
+            put("p_container", 40)
+        elif tb == "partsupp":
+            put("ps_partkey", c["part"], 1, c["part"])
+            put("ps_suppkey", c["supplier"], 1, c["supplier"])
+            put("ps_availqty", 9999, 1, 9999)
+        elif tb == "nation":
+            put("n_nationkey", 25, 0, 24)
+            put("n_regionkey", 5, 0, 4)
+            put("n_name", 25)
+        elif tb == "region":
+            put("r_regionkey", 5, 0, 4)
+            put("r_name", 5)
         return TableStatistics(row_count=float(rows), columns=cols)
 
 

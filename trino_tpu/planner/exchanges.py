@@ -53,11 +53,14 @@ class ExchangePlanner:
     def __init__(self, metadata: Metadata, allocator: SymbolAllocator,
                  broadcast_threshold: float = BROADCAST_THRESHOLD,
                  join_distribution: str = "AUTOMATIC"):
+        from .stats import StatsCalculator
+
         self.metadata = metadata
         self.allocator = allocator
         self.broadcast_threshold = broadcast_threshold
         self.join_distribution = join_distribution
         self._est = Optimizer(metadata, allocator)
+        self._stats = StatsCalculator(metadata)
 
     def run(self, root: OutputNode) -> OutputNode:
         node, dist = self.visit(root.source)
@@ -152,7 +155,10 @@ class ExchangePlanner:
         lkeys = [l for l, _ in node.criteria]
         rkeys = [r for _, r in node.criteria]
 
-        right_rows = self._est._base_rows(node.right)
+        # stats-based build-size estimate: predicate selectivity and
+        # join/agg cardinality included, not just base table rows
+        # (reference: CostComparator driving the distribution choice)
+        right_rows = self._stats.stats(node.right).row_count
         if node.join_type == "full":
             # broadcast would emit each unmatched build row once PER
             # probe task; FULL must co-partition both sides on the join

@@ -157,18 +157,24 @@ class Optimizer:
                     break
             if not placed:
                 remaining.append(p)
-        # estimate sizes BEFORE wrapping in FilterNodes (else the pushed
-        # conjuncts would be double-counted by _base_rows)
-        sizes = [self._estimate_rows(r, len(ps))
-                 for r, ps in zip(relations, per_rel)]
         relations = [self.push_filters(r, ps)
                      for r, ps in zip(relations, per_rel)]
+        # statistics-based sizes: the calculator applies predicate
+        # selectivity from connector column stats (ndv/min-max), not a
+        # flat per-filter coefficient (reference: cost/StatsCalculator
+        # feeding the join-order rules)
+        from .stats import StatsCalculator
+
+        calc = StatsCalculator(self.metadata)
+        sizes = [calc.stats(r).row_count for r in relations]
 
         if len(relations) == 1:
             return _apply(relations[0], remaining)
 
         # greedy: start from the largest (probe side stays streaming),
-        # repeatedly join the smallest connected relation as build side
+        # then repeatedly join the connected relation whose join yields
+        # the smallest estimated OUTPUT (cost-based, not just smallest
+        # input — reference: ReorderJoins' CostComparator choice)
         order = sorted(range(len(relations)), key=lambda i: -sizes[i])
         joined_idx = {order[0]}
         plan = relations[order[0]]
@@ -191,19 +197,22 @@ class Optimizer:
             return eqs
 
         while unjoined:
-            best = None
+            best = None  # ((est output rows, build rows), i, eqs)
             for i in unjoined:
                 cand_syms = rel_syms[i]
                 eqs = equi_edges(available, cand_syms)
                 if eqs:
-                    if best is None or sizes[i] < sizes[best[0]]:
-                        best = (i, eqs)
+                    cand = JoinNode("inner", plan, relations[i],
+                                    [(l, r) for l, r, _ in eqs])
+                    key = (calc.stats(cand).row_count, sizes[i])
+                    if best is None or key < best[0]:
+                        best = (key, i, eqs)
             if best is None:
                 # no connected relation: cross join the smallest
                 i = min(unjoined, key=lambda j: sizes[j])
                 plan = self._cross_join(plan, relations[i])
             else:
-                i, eqs = best
+                _, i, eqs = best
                 criteria = [(l, r) for l, r, _ in eqs]
                 used = {id(p) for _, _, p in eqs}
                 residuals = [p for p in residuals if id(p) not in used]
