@@ -1,0 +1,188 @@
+"""Access control, event listeners, resource groups, config scopes.
+
+Reference analog: ``spi/security``/``spi/eventlistener`` behaviors,
+``execution/resourcegroups/TestInternalResourceGroup``, and the
+``etc/``-directory bootstrap of ``server/Server.java``.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.events import EventListener
+from trino_tpu.resource_groups import (QueryQueueFullError,
+                                       ResourceGroupManager,
+                                       ResourceGroupSpec)
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.security import (AccessDeniedError, RuleBasedAccessControl,
+                                TableRule)
+from trino_tpu.sql.analyzer import Session
+
+
+def make_runner(**kw):
+    return LocalQueryRunner(
+        {"tpch": TpchConnector(page_rows=2048), "mem": MemoryConnector()},
+        Session(catalog="tpch", schema="micro", user=kw.pop("user", "alice")),
+        **kw)
+
+
+# -- access control ----------------------------------------------------
+
+ANALYST_RULES = RuleBasedAccessControl([
+    TableRule(user="alice", catalog="tpch", privileges=["SELECT"]),
+    TableRule(user="alice", catalog="mem", privileges=["OWNERSHIP",
+                                                       "SELECT",
+                                                       "INSERT"]),
+    TableRule(user="bob", catalog="tpch", table="nation",
+              privileges=["SELECT"], columns=["n_name", "n_regionkey"]),
+])
+
+
+def test_select_allowed_and_denied():
+    r = make_runner(access_control=ANALYST_RULES)
+    assert r.execute("select count(*) from nation").rows == [(25,)]
+    r2 = make_runner(access_control=ANALYST_RULES, user="carol")
+    with pytest.raises(AccessDeniedError):
+        r2.execute("select count(*) from nation")
+
+
+def test_column_level_rules():
+    r = make_runner(access_control=ANALYST_RULES, user="bob")
+    assert r.execute("select n_name from nation limit 1").rows
+    with pytest.raises(AccessDeniedError):
+        r.execute("select n_comment from nation limit 1")
+    with pytest.raises(AccessDeniedError):
+        r.execute("select count(*) from region")
+
+
+def test_write_privileges():
+    r = make_runner(access_control=ANALYST_RULES)
+    r.execute("create table mem.default.t1 (x bigint)")
+    r.execute("insert into mem.default.t1 values (1)")
+    with pytest.raises(AccessDeniedError):
+        r.execute("create table tpch.micro.nope (x bigint)")
+
+
+def test_query_user_gate():
+    ac = RuleBasedAccessControl([TableRule(privileges=["SELECT"])],
+                                query_users="alice|bob")
+    r = make_runner(access_control=ac, user="mallory")
+    with pytest.raises(AccessDeniedError):
+        r.execute("select 1")
+
+
+# -- event listeners ---------------------------------------------------
+
+class Recorder(EventListener):
+    def __init__(self):
+        self.created = []
+        self.completed = []
+
+    def query_created(self, e):
+        self.created.append(e)
+
+    def query_completed(self, e):
+        self.completed.append(e)
+
+
+def test_events_success_and_failure():
+    rec = Recorder()
+    r = make_runner(event_listeners=[rec])
+    r.execute("select count(*) from nation")
+    assert len(rec.created) == 1 and len(rec.completed) == 1
+    done = rec.completed[0]
+    assert done.state == "FINISHED" and done.output_rows == 1
+    assert done.user == "alice" and done.wall_ms >= 0
+    with pytest.raises(Exception):
+        r.execute("select * from no_such_table")
+    assert rec.completed[1].state == "FAILED"
+    assert rec.completed[1].error_message
+
+
+# -- resource groups ---------------------------------------------------
+
+def test_resource_group_concurrency_and_queue():
+    mgr = ResourceGroupManager([ResourceGroupSpec(
+        "global", max_concurrency=1, max_queued=1)])
+    g = mgr.select("alice")
+    g.acquire()
+    # one more fits the queue but times out waiting; the next rejects
+    t0 = time.time()
+    with pytest.raises(QueryQueueFullError):
+        g.acquire(timeout=0.1)
+    assert time.time() - t0 >= 0.1
+
+    results = []
+
+    def waiter():
+        with g.run(timeout=5):
+            results.append("ran")
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    time.sleep(0.05)
+    g.release()
+    th.join(timeout=5)
+    assert results == ["ran"]
+
+
+def test_resource_group_selectors_and_hierarchy():
+    mgr = ResourceGroupManager.from_config({"groups": [
+        {"name": "admin", "user": "admin", "max_concurrency": 5},
+        {"name": "global", "max_concurrency": 2, "subgroups": [
+            {"name": "etl", "user": "etl_.*", "max_concurrency": 1},
+        ]},
+    ]})
+    assert mgr.select("admin").name == "admin"
+    assert mgr.select("etl_nightly").name == "global.etl"
+    assert mgr.select("alice").name == "global"
+    # parent cap applies transitively
+    etl = mgr.select("etl_nightly")
+    alice = mgr.select("alice")
+    etl.acquire()
+    alice.acquire()
+    with pytest.raises(QueryQueueFullError):
+        mgr.select("etl_other").acquire(timeout=0.05)
+
+
+def test_runner_admission():
+    mgr = ResourceGroupManager([ResourceGroupSpec(
+        "global", max_concurrency=1, max_queued=0)])
+    r = make_runner(resource_groups=mgr)
+    assert r.execute("select 1").rows == [(1,)]  # released after each
+
+
+# -- config scopes -----------------------------------------------------
+
+def test_load_etc(tmp_path):
+    from trino_tpu.config import load_etc
+
+    (tmp_path / "catalog").mkdir()
+    (tmp_path / "config.properties").write_text(
+        "default-catalog=tiny_tpch\n")
+    (tmp_path / "catalog" / "tiny_tpch.properties").write_text(
+        "connector.name=tpch\npage_rows=1024\n")
+    (tmp_path / "catalog" / "scratch.properties").write_text(
+        "connector.name=memory\n")
+    (tmp_path / "access-control.json").write_text(json.dumps({
+        "tables": [{"user": ".*", "privileges": ["SELECT"]}]}))
+    (tmp_path / "resource-groups.json").write_text(json.dumps({
+        "groups": [{"name": "global", "max_concurrency": 4}]}))
+
+    cfg = load_etc(str(tmp_path))
+    assert set(cfg.connectors) == {"tiny_tpch", "scratch"}
+    assert cfg.default_catalog == "tiny_tpch"
+    assert cfg.connectors["tiny_tpch"].page_rows == 1024
+    assert cfg.resource_groups is not None
+
+    r = LocalQueryRunner(cfg.connectors,
+                         Session(catalog="tiny_tpch", schema="micro"),
+                         access_control=cfg.access_control,
+                         resource_groups=cfg.resource_groups)
+    assert r.execute("select count(*) from region").rows == [(5,)]
+    with pytest.raises(AccessDeniedError):
+        r.execute("create table scratch.default.x (a bigint)")

@@ -34,7 +34,7 @@ def create_catalog(name: str, config: dict) -> Connector:
     if kind == "tpcds":
         from .tpcds import TpcdsConnector
 
-        return TpcdsConnector(**options)
+        return TpcdsConnector(catalog_name=name, **options)
     raise TrinoError(f"unknown connector '{kind}' for catalog '{name}'",
                      "CATALOG_NOT_FOUND")
 

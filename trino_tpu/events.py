@@ -1,0 +1,105 @@
+"""Query event listeners.
+
+Reference analog: ``core/trino-spi/.../eventlistener/`` (EventListener,
+QueryCreatedEvent, QueryCompletedEvent) + ``event/QueryMonitor.java``
+building the payloads and ``EventListenerManager`` fanning them out.
+Listener failures are swallowed (an observability plugin must not fail
+queries) — the reference contract.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass(frozen=True)
+class QueryCreatedEvent:
+    query_id: str
+    user: str
+    sql: str
+    create_time: float
+
+
+@dataclass(frozen=True)
+class QueryCompletedEvent:
+    query_id: str
+    user: str
+    sql: str
+    create_time: float
+    end_time: float
+    state: str                      # FINISHED | FAILED
+    output_rows: int = 0
+    error_code: Optional[str] = None
+    error_message: Optional[str] = None
+
+    @property
+    def wall_ms(self) -> float:
+        return (self.end_time - self.create_time) * 1e3
+
+
+class EventListener:
+    """Subclass hooks (reference: spi/eventlistener/EventListener.java)."""
+
+    def query_created(self, event: QueryCreatedEvent):
+        pass
+
+    def query_completed(self, event: QueryCompletedEvent):
+        pass
+
+
+@dataclass
+class EventListenerManager:
+    listeners: List[EventListener] = field(default_factory=list)
+    _counter: int = 0
+
+    def add(self, listener: EventListener):
+        self.listeners.append(listener)
+
+    def next_query_id(self) -> str:
+        self._counter += 1
+        return f"query_{self._counter}"
+
+    def fire_created(self, event: QueryCreatedEvent):
+        for listener in self.listeners:
+            try:
+                listener.query_created(event)
+            except Exception:
+                pass
+
+    def fire_completed(self, event: QueryCompletedEvent):
+        for listener in self.listeners:
+            try:
+                listener.query_completed(event)
+            except Exception:
+                pass
+
+
+class QueryMonitor:
+    """Builds + fires the event pair around one query execution
+    (reference: event/QueryMonitor.java)."""
+
+    def __init__(self, manager: EventListenerManager, user: str,
+                 sql: str):
+        self.manager = manager
+        self.user = user
+        self.sql = sql
+        self.query_id = manager.next_query_id()
+        self.create_time = time.time()
+
+    def created(self):
+        self.manager.fire_created(QueryCreatedEvent(
+            self.query_id, self.user, self.sql, self.create_time))
+
+    def completed(self, output_rows: int):
+        self.manager.fire_completed(QueryCompletedEvent(
+            self.query_id, self.user, self.sql, self.create_time,
+            time.time(), "FINISHED", output_rows))
+
+    def failed(self, error: Exception):
+        self.manager.fire_completed(QueryCompletedEvent(
+            self.query_id, self.user, self.sql, self.create_time,
+            time.time(), "FAILED",
+            error_code=getattr(error, "code", type(error).__name__),
+            error_message=str(error)))

@@ -1,0 +1,134 @@
+"""Resource groups: admission control with hierarchical concurrency and
+queue limits.
+
+Reference analog: ``execution/resourcegroups/InternalResourceGroup.java``
++ ``InternalResourceGroupManager`` with selector-based routing
+(``plugin/trino-resource-group-managers``'s file config form). A query
+is routed to the first group whose selector matches its user, then must
+acquire a running slot: groups cap hard concurrency (and their parents'
+caps apply transitively); when full, queries wait in a bounded queue —
+a full queue rejects with QUERY_QUEUE_FULL, the reference behavior.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .types import TrinoError
+
+
+class QueryQueueFullError(TrinoError):
+    def __init__(self, group: str):
+        super().__init__(
+            f"Too many queued queries for resource group '{group}'",
+            "QUERY_QUEUE_FULL")
+
+
+@dataclass
+class ResourceGroupSpec:
+    name: str
+    max_concurrency: int = 10
+    max_queued: int = 100
+    user_pattern: str = ".*"        # selector: route by user
+    subgroups: List["ResourceGroupSpec"] = field(default_factory=list)
+
+
+class ResourceGroup:
+    def __init__(self, spec: ResourceGroupSpec,
+                 parent: Optional["ResourceGroup"] = None):
+        self.spec = spec
+        self.parent = parent
+        self.name = spec.name if parent is None \
+            else f"{parent.name}.{spec.name}"
+        self.running = 0
+        self.queued = 0
+        # ONE condition per tree: a release in any subgroup may free
+        # shared ancestor capacity a SIBLING's waiter is blocked on, and
+        # ancestor counters must mutate under one lock
+        self._cond = parent._cond if parent is not None \
+            else threading.Condition()
+        self.subgroups = [ResourceGroup(s, self) for s in spec.subgroups]
+
+    def _chain(self) -> List["ResourceGroup"]:
+        out = []
+        g: Optional[ResourceGroup] = self
+        while g is not None:
+            out.append(g)
+            g = g.parent
+        return out
+
+    def _can_run_locked(self) -> bool:
+        return all(g.running < g.spec.max_concurrency
+                   for g in self._chain())
+
+    def acquire(self, timeout: Optional[float] = None):
+        """Block until a running slot frees up along the whole ancestor
+        chain; reject immediately when this group's queue is full."""
+        with self._cond:
+            if not self._can_run_locked():
+                if self.queued >= self.spec.max_queued:
+                    raise QueryQueueFullError(self.name)
+                self.queued += 1
+                try:
+                    ok = self._cond.wait_for(self._can_run_locked,
+                                             timeout=timeout)
+                    if not ok:
+                        raise QueryQueueFullError(self.name)
+                finally:
+                    self.queued -= 1
+            for g in self._chain():
+                g.running += 1
+
+    def release(self):
+        with self._cond:
+            for g in self._chain():
+                g.running -= 1
+            self._cond.notify_all()
+
+    @contextmanager
+    def run(self, timeout: Optional[float] = None):
+        self.acquire(timeout)
+        try:
+            yield self
+        finally:
+            self.release()
+
+
+class ResourceGroupManager:
+    """Routes users to groups, depth-first first-match over selectors
+    (reference: selector rules in resource-group config files)."""
+
+    def __init__(self, specs: List[ResourceGroupSpec]):
+        self.roots = [ResourceGroup(s) for s in specs]
+
+    @classmethod
+    def from_config(cls, doc: dict) -> "ResourceGroupManager":
+        def spec(d: dict) -> ResourceGroupSpec:
+            return ResourceGroupSpec(
+                name=d["name"],
+                max_concurrency=int(d.get("max_concurrency", 10)),
+                max_queued=int(d.get("max_queued", 100)),
+                user_pattern=d.get("user", ".*"),
+                subgroups=[spec(s) for s in d.get("subgroups", [])])
+
+        return cls([spec(d) for d in doc.get("groups",
+                                             [{"name": "global"}])])
+
+    def select(self, user: str) -> ResourceGroup:
+        def match(groups: List[ResourceGroup]) -> Optional[ResourceGroup]:
+            for g in groups:
+                if re.fullmatch(g.spec.user_pattern, user):
+                    sub = match(g.subgroups)
+                    return sub if sub is not None else g
+            return None
+
+        got = match(self.roots)
+        if got is None:
+            raise TrinoError(
+                f"no resource group matches user '{user}'",
+                "QUERY_REJECTED")
+        return got

@@ -39,11 +39,38 @@ class QueryResult:
 class LocalQueryRunner:
     def __init__(self, connectors: Dict[str, Connector],
                  session: Optional[Session] = None,
-                 desired_splits: int = 4):
+                 desired_splits: int = 4,
+                 access_control=None,
+                 event_listeners: Optional[Sequence] = None,
+                 resource_groups=None):
+        from .events import EventListenerManager
+        from .security import ALLOW_ALL
+
         self.metadata = Metadata(connectors)
         self.session = session or Session(
             catalog=next(iter(connectors), None))
         self.desired_splits = desired_splits
+        self.access_control = access_control or ALLOW_ALL
+        self.event_manager = EventListenerManager(
+            list(event_listeners or ()))
+        self.resource_groups = resource_groups
+
+    def _check_table_access(self, stmt: ast.Statement, root: OutputNode):
+        """Enforce SELECT on every scanned table with its column set
+        (reference: AccessControlManager.checkCanSelectFromColumns at
+        analysis time)."""
+        from .planner.plan import TableScanNode
+
+        def walk(node):
+            if isinstance(node, TableScanNode):
+                self.access_control.check_can_select(
+                    self.session.user, node.catalog, node.table.schema,
+                    node.table.table,
+                    [col.name for _, col in node.assignments])
+            for s in node.sources:
+                walk(s)
+
+        walk(root)
 
     # ------------------------------------------------------------------
 
@@ -63,6 +90,33 @@ class LocalQueryRunner:
         return plan_tree_str(self.plan_statement(stmt))
 
     def execute(self, sql: str) -> QueryResult:
+        """Admission (resource group) + access control + event firing
+        around one statement (reference: DispatchManager.createQuery's
+        admission path + QueryMonitor)."""
+        from .events import QueryMonitor
+
+        self.access_control.check_can_execute_query(self.session.user)
+        monitor = QueryMonitor(self.event_manager, self.session.user,
+                               sql) if self.event_manager.listeners \
+            else None
+        if monitor:
+            monitor.created()
+        try:
+            if self.resource_groups is not None:
+                group = self.resource_groups.select(self.session.user)
+                with group.run():
+                    res = self._execute_sql(sql)
+            else:
+                res = self._execute_sql(sql)
+        except Exception as e:
+            if monitor:
+                monitor.failed(e)
+            raise
+        if monitor:
+            monitor.completed(len(res.rows))
+        return res
+
+    def _execute_sql(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
         if isinstance(stmt, ast.Explain):
             if stmt.analyze:
@@ -75,6 +129,8 @@ class LocalQueryRunner:
             from .exec.local_planner import _eval_literal
             from .sql.analyzer import ExpressionAnalyzer, Scope
 
+            self.access_control.check_can_set_session_property(
+                self.session.user, stmt.name)
             an = ExpressionAnalyzer(Scope([], None), self.session)
             SP.set_property(self.session.properties, stmt.name,
                             _eval_literal(an.analyze(stmt.value)))
@@ -122,7 +178,13 @@ class LocalQueryRunner:
             return self._drop_table(stmt)
         if isinstance(stmt, ast.Delete):
             return self._delete(stmt)
+        if isinstance(stmt, ast.Insert):
+            catalog, _, schema, table = self.metadata.resolve_target(
+                stmt.table, self.session)
+            self.access_control.check_can_insert(
+                self.session.user, catalog, schema, table)
         root = self.plan_statement(stmt)
+        self._check_table_access(stmt, root)
         local = self._make_local_planner()
         plan = local.plan(root)
         pages = plan.execute()
@@ -169,6 +231,7 @@ class LocalQueryRunner:
         import time as _time
 
         root = self.plan_statement(stmt)
+        self._check_table_access(stmt, root)  # ANALYZE executes the query
         local = self._make_local_planner()
         pool = local.memory_pool
         plan = local.plan(root)
@@ -197,14 +260,16 @@ class LocalQueryRunner:
         return conn
 
     def _target(self, name):
-        _, conn, schema, table = self.metadata.resolve_target(
+        catalog, conn, schema, table = self.metadata.resolve_target(
             name, self.session)
-        return conn, schema, table
+        return catalog, conn, schema, table
 
     def _create_table(self, stmt: ast.CreateTable) -> QueryResult:
         from .connectors.spi import ColumnHandle
 
-        conn, schema, table = self._target(stmt.name)
+        catalog, conn, schema, table = self._target(stmt.name)
+        self.access_control.check_can_create_table(
+            self.session.user, catalog, schema, table)
         if stmt.if_not_exists and \
                 conn.metadata().get_table_handle(schema, table) is not None:
             return QueryResult(["result"], [T.BOOLEAN], [(True,)])
@@ -214,7 +279,9 @@ class LocalQueryRunner:
         return QueryResult(["result"], [T.BOOLEAN], [(True,)])
 
     def _drop_table(self, stmt: ast.DropTable) -> QueryResult:
-        conn, schema, table = self._target(stmt.name)
+        catalog, conn, schema, table = self._target(stmt.name)
+        self.access_control.check_can_drop_table(
+            self.session.user, catalog, schema, table)
         handle = conn.metadata().get_table_handle(schema, table)
         if handle is None:
             if stmt.if_exists:
@@ -230,7 +297,9 @@ class LocalQueryRunner:
         implement ConnectorMetadata delete handles)."""
         from .connectors.memory import MemoryConnector
 
-        conn, schema, table = self._target(stmt.table)
+        catalog, conn, schema, table = self._target(stmt.table)
+        self.access_control.check_can_delete(
+            self.session.user, catalog, schema, table)
         if not isinstance(conn, MemoryConnector):
             raise AnalysisError(
                 "DELETE is only supported on the memory connector")

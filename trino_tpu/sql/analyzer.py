@@ -101,6 +101,7 @@ class Session:
     start_date: _dt.date = field(default_factory=_dt.date.today)
     properties: Dict[str, str] = field(default_factory=dict)
     timezone: str = "UTC"
+    user: str = "trino"
 
 
 def coerce(expr: RowExpression, target: T.Type) -> RowExpression:
