@@ -709,7 +709,7 @@ class QueryPlanner:
                 sym = channel_for(analyzer.analyze(si.key), "worder")
                 orderings.append(Ordering(sym, si.ascending,
                                           si.nulls_last))
-            frame_mode = self._frame_mode(window)
+            frame_mode, frame_start, frame_end = self._frame_spec(window)
             functions: List[Tuple[Symbol, "WindowFunctionSpec"]] = []
             for c in group:
                 name = c.name.lower()
@@ -737,6 +737,15 @@ class QueryPlanner:
                             raise AnalysisError(
                                 f"{name} offset must be a literal")
                         offset = c.args[1].value
+                elif name == "nth_value":
+                    if len(c.args) != 2 or not isinstance(
+                            c.args[1], ast.LongLiteral) \
+                            or c.args[1].value < 1:
+                        raise AnalysisError(
+                            "nth_value takes (expr, positive literal n)")
+                    arg_sym = channel_for(analyzer.analyze(c.args[0]),
+                                          name)
+                    offset = c.args[1].value
                 elif name in ("row_number", "rank", "dense_rank"):
                     if c.args:
                         raise AnalysisError(f"{name} takes no arguments")
@@ -749,19 +758,21 @@ class QueryPlanner:
                 else:
                     raise AnalysisError(
                         f"unknown window function {name}")
-                if name in RANKING and frame_mode != "partition" \
-                        and window.frame is not None:
+                if name in RANKING and window.frame is not None \
+                        and frame_mode != "partition":
+                    # UNBOUNDED..UNBOUNDED on a ranking fn is a no-op
+                    # (accepted, as in the reference); real frames error
                     raise AnalysisError(
                         f"{name} does not take a frame")
-                mode = frame_mode
-                if name in RANKING or name in VALUE_FNS:
-                    mode = "partition"
+                mode, fs, fe = frame_mode, frame_start, frame_end
+                if name in RANKING:
+                    mode, fs, fe = "partition", None, None
                 out_t = resolve_window_type(
                     name, arg_sym.type if arg_sym else None)
                 out_sym = self.allocator.new_symbol(name, out_t)
                 functions.append(
                     (out_sym, WindowFunctionSpec(name, arg_sym, mode,
-                                                 offset)))
+                                                 offset, fs, fe)))
                 replacements[c] = out_sym
             if len(pre) != len(node.output_symbols):
                 node = ProjectNode(node, pre)
@@ -772,17 +783,53 @@ class QueryPlanner:
                 rp.scope.parent))
         return rp, replacements
 
-    def _frame_mode(self, window: ast.Window) -> str:
+    def _frame_spec(self, window: ast.Window):
+        """(mode, frame_start, frame_end): mode 'partition'/'range'/'rows'
+        with ROWS bounds as row offsets (negative = PRECEDING, None =
+        UNBOUNDED). RANGE supports only UNBOUNDED/CURRENT bounds (value
+        offsets need per-partition searchsorted — not implemented)."""
         if window.frame is None:
-            return "range" if window.order_by else "partition"
+            return ("range" if window.order_by else "partition",
+                    None, 0)
         ftype, start, end = window.frame
-        if start == "UNBOUNDED PRECEDING" and \
-                end == "UNBOUNDED FOLLOWING":
-            return "partition"
-        if start == "UNBOUNDED PRECEDING" and end == "CURRENT ROW":
-            return ftype.lower()
-        raise AnalysisError(
-            f"window frame {ftype} {start} AND {end} not supported yet")
+
+        def bound(text: str):
+            if text == "UNBOUNDED PRECEDING":
+                return None, "start"
+            if text == "UNBOUNDED FOLLOWING":
+                return None, "end"
+            if text == "CURRENT ROW":
+                return 0, None
+            n, d = text.rsplit(" ", 1)
+            try:
+                k = int(n)
+            except ValueError:
+                raise AnalysisError(
+                    f"window frame offset must be an integer literal, "
+                    f"got {n!r}")
+            return (-k if d == "PRECEDING" else k), None
+
+        s, s_side = bound(start)
+        e, e_side = bound(end)
+        if s_side == "end":
+            raise AnalysisError("frame start cannot be UNBOUNDED FOLLOWING")
+        if e_side == "start":
+            raise AnalysisError("frame end cannot be UNBOUNDED PRECEDING")
+        if s is not None and e is not None and s > e:
+            # Trino: "frame starting from following row cannot end with
+            # current row" etc. — a statically-empty frame is a typo
+            raise AnalysisError(
+                f"window frame start ({start}) cannot be after frame "
+                f"end ({end})")
+        if s is None and e is None:
+            return "partition", None, None
+        if ftype.lower() == "range":
+            if not (s is None and e == 0):
+                raise AnalysisError(
+                    "RANGE frames support only UNBOUNDED PRECEDING AND "
+                    "CURRENT ROW")
+            return "range", None, 0
+        return "rows", s, e
 
     # ------------------------------------------------------------------
     # WHERE + subqueries
