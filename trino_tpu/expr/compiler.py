@@ -52,7 +52,8 @@ class _StrView:
     def values(self, dicts) -> List[Optional[str]]:
         if self.channel is None:
             return [self.literal]
-        vals = dicts[self.channel].values
+        d = dicts[self.channel]
+        vals = d.values if d is not None else []
         if self.transform is None:
             return list(vals)
         return [None if v is None else self.transform(v) for v in vals]
@@ -81,6 +82,10 @@ class PageProcessor:
         self._slot_of: Dict[int, int] = {}   # id(plan-node) -> slot index
         self._lut_cache: Dict = {}
         self._dict_cache: Dict = {}
+        # id(projection expr) -> dicts->Dictionary, for string-valued
+        # expressions whose output pool is built per process() call
+        # (string CASE/COALESCE merge branch pools)
+        self._out_dict_resolvers: Dict[int, object] = {}
         # plan every expression once (assigns slots deterministically)
         self._plans = [self._plan(e) for e in
                        ([filter_expr] if filter_expr is not None else [])
@@ -154,6 +159,9 @@ class PageProcessor:
                 args = [s if k == "base" else v for k, v in _extra]
                 return _fn(*args)
 
+            if base.channel is None:
+                # literal base with extra args: fold on the host now
+                return _StrView(literal=chained(base.literal))
             return _StrView(channel=base.channel, transform=chained)
         raise TypeError_(f"unsupported string expression {e!r}")
 
@@ -249,6 +257,11 @@ class PageProcessor:
         if name == "$is_null":
             arg = e.args[0]
             if _is_string(arg.type):
+                if isinstance(arg, Call) and arg.name in (
+                        "$if", "$case", "$coalesce"):
+                    # nested string select: its own plan computes nulls
+                    p = self._plan(arg)
+                    return lambda env: (_nz(p(env)[1]), None)
                 np_ = self._string_nulls_plan(arg)
                 return lambda env: (_nz(np_(env)), None)
             p = self._plan(arg)
@@ -260,8 +273,16 @@ class PageProcessor:
             return ev
 
         if name == "$coalesce":
-            plans = [self._plan(a) for a in e.args]
             rt = e.type
+            if _is_string(rt):
+                # coalesce = first-non-null CASE over the branch views
+                conds = [Call(T.BOOLEAN, "$not",
+                              (Call(T.BOOLEAN, "$is_null", (a,)),))
+                         for a in e.args[:-1]]
+                return self._plan_string_select(e, conds,
+                                                list(e.args[:-1]),
+                                                e.args[-1])
+            plans = [self._plan(a) for a in e.args]
 
             def ev(env):
                 r_acc, n_acc = plans[0](env)
@@ -473,7 +494,7 @@ class PageProcessor:
             vals = pairs[1::2]
         rt = e.type
         if _is_string(rt):
-            raise TypeError_("string-valued CASE not supported on device yet")
+            return self._plan_string_select(e, conds, vals, default)
         cond_plans = [self._plan(c) for c in conds]
         val_plans = [self._plan(v) for v in vals]
         def_plan = self._plan(default)
@@ -501,6 +522,136 @@ class PageProcessor:
                 taken = taken | fires
             if out is None:
                 return out_r, out_n
+            return out, out_null
+
+        return ev
+
+    def _plan_string_select(self, e: Call, conds, vals, default):
+        """String-valued CASE/IF/COALESCE: branch values come from
+        different channels (different code pools), so each branch gets a
+        per-process remap LUT into ONE merged output pool, and the
+        select itself is plain code arithmetic on device. The merged
+        pool is append-only and cached per input-pool state, so codes
+        stay stable across pages."""
+        def decompose(expr: Call):
+            args = list(expr.args)
+            if expr.name == "$if":
+                return ([args[0]], [args[1]],
+                        args[2] if len(args) > 2
+                        else Literal(expr.type, None))
+            if expr.name == "$coalesce":
+                cs = [Call(T.BOOLEAN, "$not",
+                           (Call(T.BOOLEAN, "$is_null", (a,)),))
+                      for a in args[:-1]]
+                return cs, args[:-1], args[-1]
+            pairs, dflt = args[:-1], args[-1]
+            return pairs[0::2], pairs[1::2], dflt
+
+        def collect_views(expr, out):
+            """Leaf _StrViews of a possibly-nested select tree."""
+            if isinstance(expr, Call) and expr.name in ("$if", "$case",
+                                                        "$coalesce"):
+                cs, vs, dflt = decompose(expr)
+                for v in vs:
+                    collect_views(v, out)
+                collect_views(dflt, out)
+            else:
+                out.append(self._str_view(expr))
+            return out
+
+        all_views: List[_StrView] = []
+        for v in vals:
+            collect_views(v, all_views)
+        collect_views(default, all_views)
+        key_channels = tuple(sorted({v.channel for v in all_views
+                                     if v.channel is not None}))
+        token = ("strsel", len(self._out_dict_resolvers), id(e))
+
+        def merged_dict(dicts) -> Dictionary:
+            key = (token,) + tuple(
+                (id(dicts[c]), len(dicts[c]) if dicts[c] is not None
+                 else 0) for c in key_channels)
+            d = self._dict_cache.get(key)
+            if d is None:
+                d = Dictionary()
+                self._dict_cache[key] = d
+            return d
+
+        self._out_dict_resolvers[id(e)] = merged_dict
+
+        def code_slot(view: _StrView) -> int:
+            if view.channel is None:
+                def fill_lit(dicts, _v=view.literal):
+                    m = merged_dict(dicts)
+                    code = m.code("" if _v is None else _v)
+                    return np.asarray([code], dtype=np.int32)
+
+                return self._new_slot(fill_lit, np.int32)
+
+            def fill(dicts, _view=view):
+                m = merged_dict(dicts)
+                vals_ = _view.values(dicts)
+                arr = [m.code("" if v is None else v) for v in vals_]
+                # empty input pool: one dead entry keeps the gather legal
+                return np.asarray(arr or [m.code("")], dtype=np.int32)
+
+            return self._new_slot(fill, np.int32)
+
+        def plan_branch(expr):
+            """eval(env) -> (merged-pool code, null mask) for one branch
+            value — recursing through nested selects into the SAME
+            merged pool."""
+            if isinstance(expr, Call) and expr.name in ("$if", "$case",
+                                                        "$coalesce"):
+                cs, vs, dflt = decompose(expr)
+                cond_ps = [self._plan(c) for c in cs]
+                val_ps = [plan_branch(v) for v in vs]
+                dflt_p = plan_branch(dflt)
+
+                def sel_ev(env, _c=cond_ps, _v=val_ps, _d=dflt_p):
+                    out, out_null = _d(env)
+                    taken = jnp.asarray(False)
+                    for cp, vp in zip(_c, _v):
+                        cr, cn = cp(env)
+                        fires = cr & ~_nz(cn) & ~taken
+                        vr, vn = vp(env)
+                        out = jnp.where(fires, vr, out)
+                        out_null = jnp.where(fires, vn, out_null)
+                        taken = taken | fires
+                    return out, out_null
+
+                return sel_ev
+            view = self._str_view(expr)
+            slot = code_slot(view)
+            if view.channel is None:
+                is_null = view.literal is None
+
+                def lit_ev(env, _s=slot, _n=is_null):
+                    return env["luts"][_s][0], jnp.asarray(_n)
+
+                return lit_ev
+            codes = self._plan_str_codes(expr)
+            nulls = self._string_nulls_plan(expr)
+
+            def col_ev(env, _s=slot, _c=codes, _n=nulls):
+                return env["luts"][_s][_c(env)], _nz(_n(env))
+
+            return col_ev
+
+        cond_plans = [self._plan(c) for c in conds]
+        branch_plans = [plan_branch(v) for v in vals]
+        default_plan = plan_branch(default)
+
+        def ev(env):
+            out, out_null = default_plan(env)
+            taken = jnp.asarray(False)
+            for cp, vp in zip(cond_plans, branch_plans):
+                cr, cn = cp(env)
+                fires = cr & ~_nz(cn) & ~taken
+                vr, vn = vp(env)
+                out = jnp.where(fires, vr, out)
+                out_null = jnp.where(fires, vn, out_null)
+                taken = taken | fires
             return out, out_null
 
         return ev
@@ -620,6 +771,10 @@ class PageProcessor:
         out_dicts = []
         for j, proj in enumerate(self.projections):
             if _is_string(proj.type):
+                resolver = self._out_dict_resolvers.get(id(proj))
+                if resolver is not None:
+                    out_dicts.append(resolver(dicts))
+                    continue
                 view = self._str_view(proj)
                 if view.channel is None:
                     key = (j, "lit")

@@ -68,11 +68,19 @@ def resolve_agg_type(function: str, arg_type: Optional[T.Type]) -> T.Type:
         if arg_type.is_decimal:
             return arg_type
         return T.DOUBLE
-    if function in ("min", "max"):
+    if function in ("min", "max", "arbitrary", "any_value"):
         return arg_type
     if function in ("stddev", "stddev_samp", "stddev_pop", "variance",
-                    "var_samp", "var_pop"):
+                    "var_samp", "var_pop", "geometric_mean"):
         return T.DOUBLE
+    if function in ("bool_and", "bool_or", "every"):
+        if arg_type != T.BOOLEAN:
+            raise TypeError_(f"{function} expects boolean, got {arg_type}")
+        return T.BOOLEAN
+    if function == "count_if":
+        if arg_type != T.BOOLEAN:
+            raise TypeError_(f"count_if expects boolean, got {arg_type}")
+        return T.BIGINT
     raise TypeError_(f"unknown aggregate function {function}")
 
 
@@ -87,17 +95,17 @@ def resolve_agg_type(function: str, arg_type: Optional[T.Type]) -> T.Type:
 
 def _state_plan(agg: AggCall):
     f = agg.function
-    if f == "count_star":
-        return [("sum", jnp.int64)]
-    if f == "count":
+    if f in ("count_star", "count", "count_if"):
         return [("sum", jnp.int64)]
     if f in ("sum", "avg"):
         dt = jnp.float64 if (agg.arg_type in (T.REAL, T.DOUBLE)) else jnp.int64
         return [("sum", dt), ("sum", jnp.int64)]
-    if f == "min":
+    if f in ("min", "arbitrary", "any_value", "bool_and", "every"):
         return [("min", None), ("sum", jnp.int64)]
-    if f == "max":
+    if f in ("max", "bool_or"):
         return [("max", None), ("sum", jnp.int64)]
+    if f == "geometric_mean":
+        return [("sum", jnp.float64), ("sum", jnp.int64)]
     if f in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp",
              "var_pop"):
         return [("sum", jnp.float64), ("sum", jnp.float64),
@@ -108,19 +116,53 @@ def _state_plan(agg: AggCall):
 def intermediate_state_types(function: str,
                              arg_type: Optional[T.Type]) -> List[T.Type]:
     """SQL types of one aggregate's partial-state columns (the wire
-    layout of partial-aggregation exchange pages)."""
+    layout of partial-aggregation exchange pages). String min/max
+    states are VARCHAR: partials carry dictionary CODES so exchanges
+    unify pools; the reduce itself runs on lexicographic ranks (codes
+    are pool-order, not value-order) and maps back to codes at every
+    page boundary."""
     call = AggCall(function, None, arg_type, T.BIGINT)
     out: List[T.Type] = []
     for (kind, dt) in _state_plan(call):
         if kind in ("min", "max"):
-            out.append(T.DOUBLE if arg_type in (T.REAL, T.DOUBLE)
-                       else (arg_type or T.BIGINT))
+            if arg_type in (T.REAL, T.DOUBLE):
+                out.append(T.DOUBLE)
+            elif arg_type == T.BOOLEAN:
+                out.append(T.BIGINT)  # 0/1 lanes (bool_and/bool_or)
+            else:
+                out.append(arg_type or T.BIGINT)
         else:
             out.append(T.DOUBLE if dt == jnp.float64 else T.BIGINT)
     return out
 
 
-def _init_states(agg: AggCall, cols, nulls, valid) -> List:
+_RANK_INV_CACHE: dict = {}
+
+
+def _rank_and_inverse(dictionary):
+    """(rank_lut, inverse_lut): rank_lut[code] = dense lex rank;
+    inverse_lut[rank] = FIRST code of that rank (aligned pools may
+    repeat values). Cached per (pool, size) — pools are append-only."""
+    import numpy as np
+
+    if dictionary is None or len(dictionary) == 0:
+        return (np.zeros(1, dtype=np.int64), np.zeros(1, dtype=np.int32))
+    key = (id(dictionary), len(dictionary))
+    hit = _RANK_INV_CACHE.get(key)
+    if hit is not None and hit[0] is dictionary:
+        return hit[1], hit[2]
+    ranks = dictionary.sort_rank().astype(np.int64)
+    nr = int(ranks.max()) + 1 if len(ranks) else 1
+    inv = np.zeros(nr, dtype=np.int32)
+    # reversed scatter: the FIRST code of each rank lands last, winning
+    inv[ranks[::-1]] = np.arange(len(ranks) - 1, -1, -1, dtype=np.int32)
+    if len(_RANK_INV_CACHE) >= 256:
+        _RANK_INV_CACHE.clear()
+    _RANK_INV_CACHE[key] = (dictionary, ranks, inv)
+    return ranks, inv
+
+
+def _init_states(agg: AggCall, cols, nulls, valid, dicts=None) -> List:
     """Per-row initial state columns for one aggregate."""
     f = agg.function
     if f == "count_star":
@@ -130,6 +172,25 @@ def _init_states(agg: AggCall, cols, nulls, valid) -> List:
     live = valid & ~nl
     if f == "count":
         return [live.astype(jnp.int64)]
+    if f == "count_if":
+        return [(live & raw.astype(bool)).astype(jnp.int64)]
+    if f in ("bool_and", "every", "bool_or"):
+        # min/max over {0,1}; dead lanes take the neutral sentinel
+        neutral = 1 if f != "bool_or" else 0
+        x = jnp.where(live, raw.astype(jnp.int64), neutral)
+        return [x, live.astype(jnp.int64)]
+    if f == "geometric_mean":
+        x = raw.astype(jnp.float64)
+        if agg.arg_type is not None and agg.arg_type.is_decimal:
+            x = x / (10.0 ** agg.arg_type.scale)
+        # log(0) = -inf => result 0; log(<0) = NaN => result NaN (the
+        # reference's semantics); dead lanes are masked by `live`
+        return [jnp.where(live, jnp.log(x), 0.0),
+                live.astype(jnp.int64)]
+    if f in ("arbitrary", "any_value"):
+        f = "min"  # deterministic pick: the smallest value
+        agg = AggCall("min", agg.arg_channel, agg.arg_type,
+                      agg.output_type)
     if f in ("sum", "avg"):
         if agg.arg_type in (T.REAL, T.DOUBLE):
             x = raw.astype(jnp.float64)
@@ -137,10 +198,22 @@ def _init_states(agg: AggCall, cols, nulls, valid) -> List:
         x = raw.astype(jnp.int64)
         return [jnp.where(live, x, 0), live.astype(jnp.int64)]
     if f in ("min", "max"):
+        if agg.arg_type is not None and agg.arg_type.is_string:
+            # reduce on lexicographic RANKS (codes are pool-order);
+            # _map_rank_states restores codes after the reduce
+            rank_lut, _ = _rank_and_inverse(
+                dicts[agg.arg_channel] if dicts is not None else None)
+            ranks = jnp.asarray(rank_lut)[raw]
+            info = jnp.iinfo(jnp.int64)
+            sent = info.max if f == "min" else info.min
+            x = jnp.where(live, ranks, jnp.asarray(sent, dtype=jnp.int64))
+            return [x, live.astype(jnp.int64)]
         if agg.arg_type in (T.REAL, T.DOUBLE):
             sent = jnp.inf if f == "min" else -jnp.inf
             x = jnp.where(live, raw.astype(jnp.float64), sent)
         else:
+            if raw.dtype == jnp.bool_:
+                raw = raw.astype(jnp.int64)
             info = jnp.iinfo(raw.dtype)
             sent = info.max if f == "min" else info.min
             x = jnp.where(live, raw, jnp.asarray(sent, dtype=raw.dtype))
@@ -152,21 +225,32 @@ def _init_states(agg: AggCall, cols, nulls, valid) -> List:
     return [x, x * x, live.astype(jnp.int64)]
 
 
-def _merge_states(agg: AggCall, state_cols, valid) -> List:
+def _merge_states(agg: AggCall, state_cols, valid, state_dicts=None) -> List:
     """Partial-state columns re-entering a (final) aggregation: states
     combine with their own reduce kinds. min/max values are neutralized
     to their sentinel on invalid lanes AND on empty partials (count
     state 0 — e.g. the one empty-input row a global partial emits),
-    which would otherwise contribute a bogus 0."""
+    which would otherwise contribute a bogus 0. String min/max states
+    arrive as codes and re-enter the reduce as lexicographic ranks."""
     plan = _state_plan(agg)
     count = state_cols[-1]  # every aggregate's last state is its count
+    is_str = agg.arg_type is not None and agg.arg_type.is_string
     out = []
-    for (kind, _dt), s in zip(plan, state_cols):
+    for j, ((kind, _dt), s) in enumerate(zip(plan, state_cols)):
         if kind == "sum":
             z = jnp.zeros((), dtype=s.dtype)
             out.append(jnp.where(valid, s, z))
         else:
             live = valid & (count > 0)
+            if is_str and kind in ("min", "max"):
+                rank_lut, _ = _rank_and_inverse(
+                    state_dicts[j] if state_dicts is not None else None)
+                s = jnp.asarray(rank_lut)[s]
+                info = jnp.iinfo(jnp.int64)
+                sent = info.max if kind == "min" else info.min
+                out.append(jnp.where(live, s.astype(jnp.int64),
+                                     jnp.asarray(sent, dtype=jnp.int64)))
+                continue
             if kind == "min":
                 sent = jnp.inf if s.dtype == jnp.float64 \
                     else jnp.iinfo(s.dtype).max
@@ -181,7 +265,7 @@ def _final_project(agg: AggCall, states: List):
     """states (per-group reduced) -> (raw, null) in output_type storage."""
     f = agg.function
     ot = agg.output_type
-    if f in ("count", "count_star"):
+    if f in ("count", "count_star", "count_if"):
         return states[0], jnp.zeros(states[0].shape, dtype=jnp.bool_)
     cnt = states[-1]
     null = cnt == 0
@@ -193,8 +277,12 @@ def _final_project(agg: AggCall, states: List):
             from ..expr.functions import div_round_half_up
             return div_round_half_up(s, jnp.maximum(cnt, 1)), null
         return s.astype(jnp.float64) / jnp.maximum(cnt, 1), null
-    if f in ("min", "max"):
+    if f in ("min", "max", "arbitrary", "any_value"):
         return states[0].astype(ot.storage), null
+    if f in ("bool_and", "every", "bool_or"):
+        return (states[0] != 0), null
+    if f == "geometric_mean":
+        return jnp.exp(states[0] / jnp.maximum(cnt, 1)), null
     # stddev family
     s, s2 = states[0], states[1]
     n = jnp.maximum(cnt, 1).astype(jnp.float64)
@@ -291,6 +379,14 @@ class HashAggregationOperator(Operator):
         self._group_dicts: List = [None] * len(group_channels)
         self._kinds = tuple(k for a in self.aggregates
                             for (k, _) in _state_plan(a))
+        # per-state: True for a string min/max VALUE state (reduced as a
+        # rank, carried across pages as a code in the arg's pool)
+        self._str_state: List[bool] = []
+        for a in self.aggregates:
+            is_str = a.arg_type is not None and a.arg_type.is_string
+            for (k, _) in _state_plan(a):
+                self._str_state.append(is_str and k in ("min", "max"))
+        self._state_dicts: List = [None] * len(self._str_state)
         self._ctx = memory_context
         if self._ctx is not None:
             self._ctx.set_revoke_callback(self._revoke)
@@ -317,8 +413,24 @@ class HashAggregationOperator(Operator):
                         "group key dictionaries changed across pages; "
                         "exchange must unify pools")
                 self._group_dicts[i] = d
-        partial = self._aggregate_page(page,
-                                       intermediate=self.step == "final")
+        # string min/max state pools: same stability contract
+        intermediate = self.step == "final"
+        nkeys = len(self.group_channels)
+        k = 0
+        for a in self.aggregates:
+            for _ in _state_plan(a):
+                if self._str_state[k]:
+                    ch = (nkeys + k) if intermediate else a.arg_channel
+                    d = page.dictionaries[ch]
+                    if d is not None:
+                        prev = self._state_dicts[k]
+                        if prev is not None and prev is not d:
+                            raise TypeError_(
+                                "aggregate arg dictionaries changed "
+                                "across pages; exchange must unify pools")
+                        self._state_dicts[k] = d
+                k += 1
+        partial = self._aggregate_page(page, intermediate=intermediate)
         if self._ctx is None:
             self._partials.append(partial)
             return
@@ -361,25 +473,40 @@ class HashAggregationOperator(Operator):
             for a in self.aggregates:
                 plan = _state_plan(a)
                 raw_states = [page.cols[idx + j] for j in range(len(plan))]
+                raw_dicts = [page.dictionaries[idx + j]
+                             for j in range(len(plan))]
                 idx += len(plan)
-                state_cols.extend(_merge_states(a, raw_states, page.valid))
+                state_cols.extend(_merge_states(a, raw_states, page.valid,
+                                                raw_dicts))
         else:
             state_cols = []
             for a in self.aggregates:
                 state_cols.extend(_init_states(a, page.cols, page.nulls,
-                                               page.valid))
+                                               page.valid,
+                                               page.dictionaries))
 
         out_keys, out_key_nulls, reduced, out_valid = _group_reduce(
             tuple(key_ops), tuple(key_raws), tuple(state_cols), page.valid,
             num_keys=len(self.group_channels),
             num_states=len(state_cols), kinds=self._kinds)
 
+        # string min/max: reduced RANK -> representative CODE in the
+        # captured pool (dead/sentinel lanes clamp; count==0 nulls them)
+        reduced = list(reduced)
+        for k, is_str in enumerate(self._str_state):
+            if is_str:
+                _, inv = _rank_and_inverse(self._state_dicts[k])
+                r = jnp.clip(reduced[k], 0, len(inv) - 1)
+                reduced[k] = jnp.asarray(inv)[r].astype(jnp.int32)
+
         cols, nulls = list(out_keys), [jnp.asarray(n) for n in out_key_nulls]
         for r in reduced:
             cols.append(r)
             nulls.append(jnp.zeros_like(out_valid))
         types = self._intermediate_types()
-        dicts = list(self._group_dicts) + [None] * len(reduced)
+        dicts = list(self._group_dicts) + [
+            self._state_dicts[k] if self._str_state[k] else None
+            for k in range(len(self._str_state))]
         return DevicePage(types, cols, nulls, out_valid, dicts)
 
     def _intermediate_types(self) -> List[T.Type]:
@@ -430,7 +557,7 @@ class HashAggregationOperator(Operator):
             valid = jnp.zeros(cap, dtype=bool)
             if nkeys == 0:
                 valid = valid.at[0].set(True)
-            dicts = list(self._group_dicts) + [None] * (len(types) - nkeys)
+            dicts = list(self._group_dicts) + self._state_dict_tail()
             return DevicePage(types, cols, nulls, valid, dicts)
         from ..exec.memory import SpilledPage, device_page_bytes
 
@@ -496,7 +623,7 @@ class HashAggregationOperator(Operator):
             valid = _pad_to(jnp.concatenate([p.valid for p in dev]), cap)
             page = DevicePage(
                 types, cols, nulls, valid,
-                list(self._group_dicts) + [None] * (len(types) - nkeys))
+                list(self._group_dicts) + self._state_dict_tail())
             out = self._aggregate_page(page, intermediate=True)
         if self._ctx is not None:
             # release the transient + the chunk inputs' reservations,
@@ -526,8 +653,21 @@ class HashAggregationOperator(Operator):
             out_cols.append(raw.astype(a.output_type.storage))
             out_nulls.append(null | ~merged.valid)
         types = self.output_types
-        dicts = list(self._group_dicts) + [None] * len(self.aggregates)
+        agg_dicts = []
+        k = 0
+        for a in self.aggregates:
+            plan = _state_plan(a)
+            agg_dicts.append(self._state_dicts[k]
+                             if self._str_state[k] else None)
+            k += len(plan)
+        dicts = list(self._group_dicts) + agg_dicts
         return DevicePage(types, out_cols, out_nulls, merged.valid, dicts)
+
+    def _state_dict_tail(self) -> List:
+        """Dictionaries for the state columns of an intermediate-layout
+        page (string min/max value states keep their pool)."""
+        return [self._state_dicts[k] if self._str_state[k] else None
+                for k in range(len(self._str_state))]
 
     def is_finished(self) -> bool:
         return self._done
