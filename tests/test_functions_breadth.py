@@ -1,0 +1,209 @@
+"""Function-registry breadth: math/bitwise/string/date scalars, new
+aggregates, string-valued CASE/COALESCE/NULLIF, string min/max.
+
+Reference analog: operator/scalar/* + operator/aggregation/* unit
+suites (MathFunctions, BitwiseFunctions, DateTimeFunctions, ...).
+"""
+
+import math
+from decimal import Decimal
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.parallel.distributed import DistributedQueryRunner
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=2048)},
+                            Session(catalog="tpch", schema="micro"))
+
+
+def one(runner, sql):
+    rows = runner.execute(sql).rows
+    assert len(rows) == 1
+    return rows[0]
+
+
+def test_math_scalars(runner):
+    p, lg, s1, s2, cb = one(runner, "select power(2, 10), log(2, 8.0), "
+                                    "sign(-7), sign(0), cbrt(27.0)")
+    assert (p, lg, s1, s2) == (1024.0, 3.0, -1, 0)
+    assert abs(cb - 3.0) < 1e-9
+    (at2,) = one(runner, "select atan2(1.0, 1.0)")
+    assert abs(at2 - math.pi / 4) < 1e-9
+
+
+def test_constants_and_predicates(runner):
+    row = one(runner, "select round(pi(), 6), round(e(), 6), "
+                      "is_nan(nan()), is_infinite(infinity()), "
+                      "is_finite(1.0)")
+    assert row == (3.141593, 2.718282, True, True, True)
+
+
+def test_truncate(runner):
+    assert one(runner, "select truncate(3.99), truncate(-2.75), "
+                       "truncate(5.5e0)") == \
+        (Decimal("3.00"), Decimal("-2.00"), 5.0)
+
+
+def test_bitwise(runner):
+    assert one(runner, "select bitwise_and(12, 10), bitwise_or(12, 10), "
+                       "bitwise_xor(12, 10), bitwise_not(0), "
+                       "bitwise_left_shift(1, 4), "
+                       "bitwise_right_shift(16, 2)") == \
+        (8, 14, 6, -1, 16, 4)
+
+
+def test_string_scalars(runner):
+    assert one(runner, "select codepoint('A'), "
+                       "split_part('a,b,c', ',', 2), "
+                       "split_part('a,b', ',', 9), "
+                       "translate('abcd', 'ab', 'x')") == \
+        (65, "b", None, "xcd")
+
+
+def test_date_trunc(runner):
+    d1, d2, d3, d4 = one(runner, """
+        select date_trunc('month', date '2020-07-15'),
+               date_trunc('quarter', date '2020-08-15'),
+               date_trunc('year', date '2020-08-15'),
+               date_trunc('week', date '2026-07-30')""")
+    import datetime
+    epoch = datetime.date(1970, 1, 1)
+    assert epoch + datetime.timedelta(days=d1) == datetime.date(2020, 7, 1)
+    assert epoch + datetime.timedelta(days=d2) == datetime.date(2020, 7, 1)
+    assert epoch + datetime.timedelta(days=d3) == datetime.date(2020, 1, 1)
+    # 2026-07-30 is a Thursday; ISO week starts Monday 2026-07-27
+    assert epoch + datetime.timedelta(days=d4) == datetime.date(2026, 7, 27)
+    (h,) = one(runner, "select date_trunc('hour', "
+                       "timestamp '2020-01-01 10:45:33')")
+    assert h == 1577872800000000  # 2020-01-01T10:00:00 micros
+
+
+def test_date_diff_and_parts(runner):
+    assert one(runner, """
+        select date_diff('day', date '2020-01-01', date '2020-03-01'),
+               date_diff('hour', timestamp '2020-01-01 00:00:00',
+                         timestamp '2020-01-02 12:00:00'),
+               day_of_week(date '2026-07-30'),
+               day_of_year(date '2020-02-01'),
+               week(date '2021-01-07')""") == (60, 36, 4, 32, 1)
+
+
+def test_unixtime_roundtrip(runner):
+    ts, back = one(runner, "select to_unixtime(timestamp "
+                           "'1970-01-02 00:00:00'), "
+                           "from_unixtime(86400)")
+    assert ts == 86400.0
+    assert back.timestamp() == 86400.0
+
+
+def test_last_day_of_month(runner):
+    (d,) = one(runner,
+               "select last_day_of_month(date '2020-02-10')")
+    import datetime
+    assert datetime.date(1970, 1, 1) + datetime.timedelta(days=d) == \
+        datetime.date(2020, 2, 29)
+
+
+def test_new_aggregates(runner):
+    assert one(runner, "select bool_and(n_regionkey < 5), "
+                       "bool_or(n_regionkey = 4), "
+                       "every(n_regionkey >= 0) from nation") == \
+        (True, True, True)
+    assert one(runner, "select count_if(n_regionkey = 0) from nation") \
+        == (5,)
+    assert one(runner, "select approx_distinct(n_regionkey) from nation") \
+        == (5,)
+    gm = one(runner, "select geometric_mean(n_nationkey + 1) "
+                     "from nation")[0]
+    want = math.exp(sum(math.log(i + 1) for i in range(25)) / 25)
+    assert abs(gm - want) < 1e-6
+    arb, av = one(runner, "select arbitrary(n_name), any_value(n_name) "
+                          "from nation where n_regionkey = 2")
+    assert arb == "CHINA" and av == "CHINA"
+
+
+def test_string_min_max(runner):
+    assert one(runner, "select min(n_name), max(n_name) from nation") == \
+        ("ALGERIA", "VIETNAM")
+    rows = runner.execute(
+        "select n_regionkey, min(n_name) from nation "
+        "group by 1 order by 1").rows
+    assert rows[0] == (0, "ALGERIA") and rows[2] == (2, "CHINA")
+
+
+def test_string_min_max_distributed():
+    conn = TpchConnector(page_rows=2048)
+    d = DistributedQueryRunner({"tpch": conn},
+                               Session(catalog="tpch", schema="micro"),
+                               n_workers=3, desired_splits=8,
+                               broadcast_threshold=300.0)
+    rows = d.execute("select n_regionkey, min(n_name), max(n_name) "
+                     "from nation group by 1 order by 1").rows
+    assert rows[0] == (0, "ALGERIA", "MOZAMBIQUE")
+    assert rows[4] == (4, "EGYPT", "SAUDI ARABIA")
+
+
+def test_string_case_coalesce_nullif(runner):
+    rows = runner.execute("""
+        select case when n_regionkey = 0 then n_name else 'other' end
+        from nation order by n_nationkey limit 3""").rows
+    assert rows == [("ALGERIA",), ("other",), ("other",)]
+    assert one(runner, "select coalesce(cast(null as varchar), 'x')") \
+        == ("x",)
+    assert one(runner, "select nullif('a', 'a'), nullif('a', 'b')") == \
+        (None, "a")
+    # nested select + group over the merged pool
+    rows = runner.execute("""
+        select x, count(*) from (
+            select coalesce(nullif(n_name, 'ALGERIA'), 'SUB') x
+            from nation) group by x order by x limit 2""").rows
+    assert rows == [("ARGENTINA", 1), ("BRAZIL", 1)]
+
+
+def test_string_case_over_join(runner):
+    rows = runner.execute("""
+        select r_name, coalesce(x.nm, 'NONE')
+        from region left join (
+            select n_regionkey rk, min(n_name) nm from nation
+            where n_nationkey < 3 group by n_regionkey) x
+        on r_regionkey = rk order by r_regionkey""").rows
+    assert rows == [("AFRICA", "ALGERIA"), ("AMERICA", "ARGENTINA"),
+                    ("ASIA", "NONE"), ("EUROPE", "NONE"),
+                    ("MIDDLE EAST", "NONE")]
+
+
+def test_mixed_distinct_aggregates(runner):
+    # reference plans MarkDistinct; here the decomposable-reaggregation
+    # rewrite (inner group by (k, x) carrying non-distinct partials)
+    assert one(runner, "select count(distinct n_regionkey), count(*) "
+                       "from nation") == (5, 25)
+    rows = runner.execute("""
+        select n_regionkey, count(distinct n_name), sum(n_nationkey),
+               max(n_name)
+        from nation group by 1 order by 1 limit 2""").rows
+    assert rows == [(0, 5, 50, "MOZAMBIQUE"),
+                    (1, 5, 47, "UNITED STATES")]
+    c, s, n = one(runner, "select count(distinct o_custkey), "
+                          "sum(o_totalprice), count(*) from orders")
+    assert n == 1500 and c <= n and s > 0
+
+
+def test_delete_via_plan_quoted_identifiers():
+    from trino_tpu.connectors.memory import MemoryConnector
+
+    r = LocalQueryRunner({"mem": MemoryConnector()},
+                         Session(catalog="mem", schema="default"))
+    r.execute('create table "weird col" (x bigint, "select" varchar)')
+    r.execute("insert into \"weird col\" values "
+              "(1, 'a'), (2, 'b'), (3, null)")
+    # NULL predicate rows are KEPT (not deleted), per SQL semantics
+    assert r.execute(
+        'delete from "weird col" where "select" = \'a\'').rows == [(1,)]
+    assert r.execute('select count(*) from "weird col"').rows == [(2,)]
+    assert r.execute('delete from "weird col"').rows == [(2,)]

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
+from ..types import is_numeric
 from ..types import TypeError_
 
 
@@ -740,3 +741,290 @@ register(ScalarFunction(
 register(ScalarFunction(
     "rpad", _resolve_str_to_str(lambda n: n == 3),
     str_transform=lambda s, n, pad: s.ljust(int(n), pad[:1] or " ")[:int(n)]))
+
+
+# ---------------------------------------------------------------------------
+# math breadth (reference: operator/scalar/MathFunctions.java)
+
+
+def _resolve_binary_double(args):
+    if len(args) != 2:
+        raise TypeError_(f"expected 2 arguments, got {len(args)}")
+    for a in args:
+        if not (is_numeric(a)):
+            raise TypeError_(f"expected numeric, got {a}")
+    return T.DOUBLE
+
+
+def _binary_double(op):
+    def kernel(raws, arg_types, ret_type):
+        a = _to_float(raws[0], arg_types[0])
+        b = _to_float(raws[1], arg_types[1])
+        return op(a, b)
+
+    return kernel
+
+
+register(ScalarFunction("power", _resolve_binary_double,
+                        _binary_double(jnp.power)))
+register(ScalarFunction("pow", _resolve_binary_double,
+                        _binary_double(jnp.power)))
+register(ScalarFunction("atan2", _resolve_binary_double,
+                        _binary_double(jnp.arctan2)))
+register(ScalarFunction(
+    "log", _resolve_binary_double,
+    _binary_double(lambda b, x: jnp.log(x) / jnp.log(b))))
+
+for _n, _f in [("cbrt", jnp.cbrt), ("asin", jnp.arcsin),
+               ("acos", jnp.arccos), ("atan", jnp.arctan),
+               ("sinh", jnp.sinh), ("cosh", jnp.cosh),
+               ("tanh", jnp.tanh), ("degrees", jnp.degrees),
+               ("radians", jnp.radians), ("log2", jnp.log2)]:
+    register(ScalarFunction(_n, _resolve_unary_double, _unary_double(_f)))
+
+
+def _resolve_sign(args):
+    (a,) = args
+    if not is_numeric(a):
+        raise TypeError_(f"sign expects numeric, got {a}")
+    return T.DOUBLE if a in (T.REAL, T.DOUBLE) else T.BIGINT
+
+
+def _sign_kernel(raws, arg_types, ret_type):
+    x = raws[0]
+    if arg_types[0].is_decimal or arg_types[0] not in (T.REAL, T.DOUBLE):
+        return jnp.sign(x.astype(jnp.int64))
+    return jnp.sign(x.astype(jnp.float64))
+
+
+register(ScalarFunction("sign", _resolve_sign, _sign_kernel))
+
+
+def _resolve_truncate(args):
+    if not (1 <= len(args) <= 2):
+        raise TypeError_(f"truncate expects 1-2 arguments, got {len(args)}")
+    if not is_numeric(args[0]):
+        raise TypeError_(f"truncate expects numeric, got {args[0]}")
+    if len(args) == 2 and not _is_int(args[1]):
+        raise TypeError_("truncate digit count must be an integer")
+    return T.DOUBLE if args[0] in (T.REAL, T.DOUBLE) else args[0]
+
+
+def _truncate_kernel(raws, arg_types, ret_type):
+    t = arg_types[0]
+    x = raws[0]
+    n = raws[1].astype(jnp.int64) if len(raws) > 1 else jnp.int64(0)
+    if t in (T.REAL, T.DOUBLE):
+        f = jnp.power(10.0, n.astype(jnp.float64))
+        return jnp.trunc(x.astype(jnp.float64) * f) / f
+    if t.is_decimal and t.scale:
+        # zero digits beyond n decimal places, toward zero
+        keep = jnp.clip(jnp.int64(t.scale) - n, 0, t.scale)
+        f = (10 ** keep.astype(jnp.float64)).astype(jnp.int64)
+        return jnp.sign(x) * (jnp.abs(x) // f) * f
+    return x
+
+
+register(ScalarFunction("truncate", _resolve_truncate, _truncate_kernel))
+
+
+def _resolve_double_predicate(args):
+    if not is_numeric(args[0]):
+        raise TypeError_(f"expected numeric, got {args[0]}")
+    return T.BOOLEAN
+
+
+register(ScalarFunction(
+    "is_nan", _resolve_double_predicate,
+    lambda raws, at, rt: jnp.isnan(_to_float(raws[0], at[0]))))
+register(ScalarFunction(
+    "is_finite", _resolve_double_predicate,
+    lambda raws, at, rt: jnp.isfinite(_to_float(raws[0], at[0]))))
+register(ScalarFunction(
+    "is_infinite", _resolve_double_predicate,
+    lambda raws, at, rt: jnp.isinf(_to_float(raws[0], at[0]))))
+
+for _n, _v in [("pi", np.pi), ("e", np.e), ("nan", np.nan),
+               ("infinity", np.inf)]:
+    register(ScalarFunction(
+        _n, lambda args, _n=_n: T.DOUBLE if not args
+        else (_ for _ in ()).throw(TypeError_(f"{_n} takes no args")),
+        lambda raws, at, rt, _v=_v: jnp.float64(_v)))
+
+
+# bitwise (reference: operator/scalar/BitwiseFunctions.java)
+
+def _resolve_bitwise(args):
+    for a in args:
+        if not _is_int(a):
+            raise TypeError_(f"bitwise function expects integers, got {a}")
+    return T.BIGINT
+
+
+for _n, _f in [("bitwise_and", jnp.bitwise_and),
+               ("bitwise_or", jnp.bitwise_or),
+               ("bitwise_xor", jnp.bitwise_xor)]:
+    register(ScalarFunction(
+        _n, _resolve_bitwise,
+        lambda raws, at, rt, _f=_f: _f(raws[0].astype(jnp.int64),
+                                       raws[1].astype(jnp.int64))))
+register(ScalarFunction(
+    "bitwise_not", _resolve_bitwise,
+    lambda raws, at, rt: ~raws[0].astype(jnp.int64)))
+register(ScalarFunction(
+    "bitwise_left_shift", _resolve_bitwise,
+    lambda raws, at, rt: raws[0].astype(jnp.int64)
+    << raws[1].astype(jnp.int64)))
+register(ScalarFunction(
+    "bitwise_right_shift", _resolve_bitwise,
+    lambda raws, at, rt: (raws[0].astype(jnp.int64).view(jnp.uint64)
+                          >> raws[1].astype(jnp.uint64))
+    .view(jnp.int64)))
+
+
+# string breadth (host pool transforms)
+
+register(ScalarFunction("codepoint", _resolve_strlen,
+                        str_scalar=lambda s: ord(s[0]) if s else 0))
+
+
+def _split_part(s, delim, n):
+    parts = s.split(delim)
+    i = int(n)
+    return parts[i - 1] if 1 <= i <= len(parts) else None
+
+
+register(ScalarFunction(
+    "split_part", _resolve_str_to_str(lambda n: n == 3),
+    str_transform=_split_part))
+register(ScalarFunction(
+    "translate", _resolve_str_to_str(lambda n: n == 3),
+    str_transform=lambda s, frm, to: s.translate(
+        {ord(f): (to[i] if i < len(to) else None)
+         for i, f in enumerate(frm)})))
+
+
+# date/time breadth (reference: operator/scalar/DateTimeFunctions.java)
+
+def _trunc_days(days, unit):
+    y, m, d = _civil_from_days(days)
+    one = jnp.ones_like(m)
+    if unit == "year":
+        return _days_from_civil(y, one, one)
+    if unit == "quarter":
+        qm = ((m - 1) // 3) * 3 + 1
+        return _days_from_civil(y, qm, one)
+    if unit == "month":
+        return _days_from_civil(y, m, one)
+    if unit == "week":  # ISO week starts Monday
+        dow = (days.astype(jnp.int64) + 3) % 7
+        return days.astype(jnp.int64) - dow
+    return days.astype(jnp.int64)
+
+
+def _date_trunc_kernel(unit):
+    day_us = np.int64(86_400_000_000)
+
+    def kernel(raws, arg_types, ret_type):
+        t = arg_types[0]
+        x = raws[0]
+        if t == T.DATE:
+            return _trunc_days(x, unit).astype(jnp.int32)
+        if t.is_timestamp_tz:
+            from .tz import device_utc_to_wall, device_wall_to_utc
+
+            wall = device_utc_to_wall(x, t.zone)
+            tr = _trunc_wall_micros(wall, unit, day_us)
+            return device_wall_to_utc(tr, t.zone)
+        return _trunc_wall_micros(x, unit, day_us)
+
+    return kernel
+
+
+def _trunc_wall_micros(x, unit, day_us):
+    if unit in ("year", "quarter", "month", "week", "day"):
+        days = jnp.floor_divide(x, day_us).astype(jnp.int32)
+        return _trunc_days(days, unit).astype(jnp.int64) * day_us
+    scale = {"hour": 3_600_000_000, "minute": 60_000_000,
+             "second": 1_000_000}[unit]
+    return (x // np.int64(scale)) * np.int64(scale)
+
+
+def _resolve_trunc_unit(args):
+    (a,) = args
+    if a in (T.DATE, T.TIMESTAMP) or a.is_timestamp_tz:
+        return a
+    raise TypeError_(f"date_trunc expects date/timestamp, got {a}")
+
+
+for _u in ("year", "quarter", "month", "week", "day", "hour", "minute",
+           "second"):
+    register(ScalarFunction(f"$date_trunc_{_u}", _resolve_trunc_unit,
+                            _date_trunc_kernel(_u)))
+
+register(ScalarFunction("day_of_week", _resolve_date_part,
+                        _date_part_kernel("day_of_week")))
+register(ScalarFunction("dow", _resolve_date_part,
+                        _date_part_kernel("day_of_week")))
+register(ScalarFunction("day_of_year", _resolve_date_part,
+                        _date_part_kernel("day_of_year")))
+register(ScalarFunction("doy", _resolve_date_part,
+                        _date_part_kernel("day_of_year")))
+register(ScalarFunction("week", _resolve_date_part,
+                        _date_part_kernel("week")))
+register(ScalarFunction("week_of_year", _resolve_date_part,
+                        _date_part_kernel("week")))
+
+
+def _resolve_last_day(args):
+    if args[0] not in (T.DATE, T.TIMESTAMP):
+        raise TypeError_("last_day_of_month expects date/timestamp")
+    return T.DATE
+
+
+def _last_day_kernel(raws, arg_types, ret_type):
+    days = _to_days(raws[0], arg_types[0])
+    y, m, _ = _civil_from_days(days)
+    return (_days_from_civil(y, m, jnp.ones_like(m))
+            + _days_in_month(y, m) - 1).astype(jnp.int32)
+
+
+register(ScalarFunction("last_day_of_month", _resolve_last_day,
+                        _last_day_kernel))
+
+
+def _resolve_to_unixtime(args):
+    if args[0] not in (T.TIMESTAMP,) and not args[0].is_timestamp_tz:
+        raise TypeError_("to_unixtime expects a timestamp")
+    return T.DOUBLE
+
+
+register(ScalarFunction(
+    "to_unixtime", _resolve_to_unixtime,
+    lambda raws, at, rt: raws[0].astype(jnp.float64) / 1e6))
+
+
+def _resolve_from_unixtime(args):
+    if not is_numeric(args[0]):
+        raise TypeError_("from_unixtime expects numeric seconds")
+    return T.timestamp_tz_type("UTC")
+
+
+register(ScalarFunction(
+    "from_unixtime", _resolve_from_unixtime,
+    lambda raws, at, rt: (_to_float(raws[0], at[0]) * 1e6)
+    .astype(jnp.int64)))
+
+
+def _resolve_ts_diff(args):
+    return T.BIGINT
+
+
+def _ts_diff_kernel(raws, arg_types, ret_type):
+    b, a, scale = raws
+    d = b.astype(jnp.int64) - a.astype(jnp.int64)
+    # truncate toward zero in whole units
+    return jnp.sign(d) * (jnp.abs(d) // scale.astype(jnp.int64))
+
+
+register(ScalarFunction("$ts_diff", _resolve_ts_diff, _ts_diff_kernel))

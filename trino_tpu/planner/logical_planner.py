@@ -586,6 +586,11 @@ class QueryPlanner:
         agg_index: Dict[Tuple, Symbol] = {}
         for call in agg_calls:
             name = call.name.lower()
+            distinct = call.distinct
+            if name == "approx_distinct":
+                # exact implementation satisfies the approximate
+                # contract (reference would use HLL; SURVEY §2.1)
+                name, distinct = "count", True
             if name == "count" and not call.args:
                 key = ("count_star", None, False)
                 fn_name, arg_sym = "count_star", None
@@ -594,8 +599,10 @@ class QueryPlanner:
                     raise AnalysisError(
                         f"aggregate {name} expects one argument")
                 arg = call.args[0]
-                if not expression_uses_scope(arg) and name == "count":
-                    # count(1) == count(*)
+                if not expression_uses_scope(arg) and name == "count" \
+                        and not distinct:
+                    # count(1) == count(*); count(DISTINCT <const>) must
+                    # NOT collapse (it counts one distinct value)
                     key = ("count_star", None, False)
                     fn_name, arg_sym = "count_star", None
                 else:
@@ -604,7 +611,7 @@ class QueryPlanner:
                         arg_expr = Literal(T.BIGINT, None)
                     arg_sym = channel_for(arg_expr, name + "_arg")
                     fn_name = name
-                    key = (name, arg_sym.name, call.distinct)
+                    key = (name, arg_sym.name, distinct)
             if key in agg_index:
                 replacements[call] = agg_index[key]
                 continue
@@ -614,28 +621,14 @@ class QueryPlanner:
                 fn_name, arg_sym.type if arg_sym else None)
             out_sym = self.allocator.new_symbol(fn_name, out_t)
             aggregations.append(
-                (out_sym, Aggregation(fn_name, arg_sym, call.distinct)))
+                (out_sym, Aggregation(fn_name, arg_sym, distinct)))
             agg_index[key] = out_sym
             replacements[call] = out_sym
 
         pre = ProjectNode(rp.node, pre_assignments)
         if any(a.distinct for _, a in aggregations):
-            # single-distinct rewrite (reference:
-            # iterative/rule/SingleDistinctAggregationToGroupBy.java):
-            # agg(distinct x) group by k  ==>  inner group by (k, x),
-            # then agg(x) group by k. Requires every aggregate distinct
-            # on the same argument.
-            args = {a.argument for _, a in aggregations}
-            if not all(a.distinct for _, a in aggregations) or \
-                    len(args) != 1 or None in args:
-                raise AnalysisError(
-                    "mixed DISTINCT/non-DISTINCT or multi-argument "
-                    "DISTINCT aggregates not supported yet")
-            arg = next(iter(args))
-            inner = AggregationNode(pre, group_keys + [arg], [])
-            aggregations = [(s, Aggregation(a.function, a.argument, False))
-                            for s, a in aggregations]
-            agg_node = AggregationNode(inner, group_keys, aggregations)
+            agg_node = self._plan_distinct_aggs(pre, group_keys,
+                                                aggregations)
         else:
             agg_node = AggregationNode(pre, group_keys, aggregations)
         fields = [FieldDef(s.name, s) for s in agg_node.output_symbols]
@@ -782,6 +775,57 @@ class QueryPlanner:
                                    for s, _ in functions],
                 rp.scope.parent))
         return rp, replacements
+
+    def _plan_distinct_aggs(self, pre, group_keys, aggregations):
+        """DISTINCT aggregates via group-by rewrite.
+
+        All-distinct on one argument (reference:
+        iterative/rule/SingleDistinctAggregationToGroupBy.java):
+            agg(distinct x) GROUP BY k
+            => inner GROUP BY (k, x), then agg(x) GROUP BY k.
+
+        Mixed distinct/non-distinct (the reference plans MarkDistinct;
+        here the same inner-group-by carries the non-distinct aggregates
+        as decomposable partials re-aggregated outside):
+            count(distinct x), sum(y) GROUP BY k
+            => inner GROUP BY (k, x): sum(y) AS sy
+               outer GROUP BY k:      count(x), sum(sy)
+        Non-distinct aggregates must re-aggregate (sum/count/min/max);
+        avg/stddev mixed with DISTINCT are rejected, as are multiple
+        distinct arguments."""
+        args = {a.argument for _, a in aggregations if a.distinct}
+        if len(args) != 1 or None in args:
+            raise AnalysisError(
+                "multiple DISTINCT aggregate arguments not supported yet")
+        arg = next(iter(args))
+        non_distinct = [(s, a) for s, a in aggregations if not a.distinct]
+        reagg = {"sum": "sum", "count": "sum", "count_star": "sum",
+                 "min": "min", "max": "max", "count_if": "sum",
+                 "bool_and": "bool_and", "bool_or": "bool_or",
+                 "every": "every", "arbitrary": "arbitrary",
+                 "any_value": "any_value"}
+        inner_aggs: List[Tuple[Symbol, Aggregation]] = []
+        outer_map: Dict[str, Tuple[str, Symbol]] = {}
+        for s, a in non_distinct:
+            outer_fn = reagg.get(a.function)
+            if outer_fn is None:
+                raise AnalysisError(
+                    f"{a.function} cannot combine with DISTINCT "
+                    "aggregates in one grouping yet")
+            part = self.allocator.new_symbol(f"{s.name}_part", s.type)
+            inner_aggs.append((part, Aggregation(a.function, a.argument,
+                                                 False)))
+            outer_map[s.name] = (outer_fn, part)
+        inner = AggregationNode(pre, group_keys + [arg], inner_aggs)
+        outer_aggs = []
+        for s, a in aggregations:
+            if a.distinct:
+                outer_aggs.append((s, Aggregation(a.function, arg,
+                                                  False)))
+            else:
+                fn, part = outer_map[s.name]
+                outer_aggs.append((s, Aggregation(fn, part, False)))
+        return AggregationNode(inner, group_keys, outer_aggs)
 
     def _frame_spec(self, window: ast.Window):
         """(mode, frame_start, frame_end): mode 'partition'/'range'/'rows'

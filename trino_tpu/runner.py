@@ -292,9 +292,11 @@ class LocalQueryRunner:
         return QueryResult(["result"], [T.BOOLEAN], [(True,)])
 
     def _delete(self, stmt: ast.Delete) -> QueryResult:
-        """DELETE via rewrite: keep rows NOT matching the predicate
-        (memory-connector-style storage replacement; reference connectors
-        implement ConnectorMetadata delete handles)."""
+        """DELETE as a real plan: the keep-query (NOT pred, null-safe)
+        is BUILT AS AST — no SQL-text round trip, so identifier quoting
+        and expression formatting can never skew semantics (round-1/2
+        advice). Storage is replaced memory-connector style (reference
+        connectors implement ConnectorMetadata delete handles)."""
         from .connectors.memory import MemoryConnector
 
         catalog, conn, schema, table = self._target(stmt.table)
@@ -309,30 +311,22 @@ class LocalQueryRunner:
                 f"table '{schema}.{table}' does not exist")
         data = conn.tables[(schema, table)]
         before = data.row_count
-        name = ".".join((conn.catalog_name, schema, table))
         if stmt.where is None:
             with data.lock:
                 data.pages = []
             return QueryResult(["rows"], [T.BIGINT], [(before,)])
-        from .sql.formatter import format_expression
-
-        try:
-            where_text = format_expression(stmt.where)
-        except NotImplementedError:
-            raise AnalysisError(
-                "DELETE with subqueries in WHERE is not supported yet")
-        keep_sql = (f"select * from {name} where "
-                    f"not coalesce(({where_text}), false)")
-        res_pages = [data.canonicalize(p)
-                     for p in self._collect_pages(keep_sql)]
+        keep = ast.NotExpression(ast.FunctionCall(
+            "coalesce", (stmt.where, ast.BooleanLiteral(False))))
+        query = ast.Query(body=ast.QuerySpecification(
+            select_items=(ast.AllColumns(),),
+            from_=ast.Table((catalog, schema, table)),
+            where=keep))
+        root = self.plan_statement(ast.QueryStatement(query))
+        plan = self._make_local_planner().plan(root)
+        res_pages = [data.canonicalize(p) for p in plan.execute()]
         with data.lock:
             data.pages = res_pages
         return QueryResult(["rows"], [T.BIGINT],
                            [(before - sum(p.num_rows
                                           for p in res_pages),)])
 
-    def _collect_pages(self, sql: str) -> List[Page]:
-        stmt = parse_statement(sql)
-        root = self.plan_statement(stmt)
-        plan = self._make_local_planner().plan(root)
-        return plan.execute()

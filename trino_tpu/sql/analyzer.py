@@ -38,6 +38,7 @@ AGGREGATE_FUNCTIONS = {
     "count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
     "stddev_pop", "variance", "var_samp", "var_pop", "count_if",
     "bool_and", "bool_or", "every", "arbitrary", "any_value",
+    "approx_distinct", "geometric_mean",
 }
 
 _COMPARISON_FN = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
@@ -509,7 +510,7 @@ class ExpressionAnalyzer:
         if name == "nullif":
             return self._an_NullIfExpression(
                 ast.NullIfExpression(e.args[0], e.args[1]))
-        if name in ("date_add", "date_diff"):
+        if name in ("date_add", "date_diff", "date_trunc"):
             return self._date_fn(name, e)
         args = [self.analyze(a) for a in e.args]
         fn = F.get_function(name)
@@ -533,6 +534,26 @@ class ExpressionAnalyzer:
                 raise AnalysisError("date_add amount must be a literal")
             ival = Literal(T.INTERVAL_DAY_SECOND, int(n.value) * scale)
             return Call(v.type, "add", (v, ival))
+        if name == "date_trunc":
+            v = self.analyze(e.args[1])
+            fn = F.get_function(f"$date_trunc_{unit}")
+            return Call(fn.resolve([v.type]), f"$date_trunc_{unit}", (v,))
+        if name == "date_diff":
+            a = self.analyze(e.args[1])
+            b = self.analyze(e.args[2])
+            scale = {"day": 86_400_000_000, "hour": 3_600_000_000,
+                     "minute": 60_000_000, "second": 1_000_000,
+                     "week": 7 * 86_400_000_000,
+                     "millisecond": 1_000}.get(unit)
+            if scale is None:
+                raise AnalysisError(f"date_diff unit {unit} not supported")
+            # b - a in micros (dates upcast), truncated toward zero by
+            # whole units (reference: DateTimeFunctions.diffDate)
+            ts = T.TIMESTAMP
+            au = coerce(a, ts) if a.type == T.DATE else a
+            bu = coerce(b, ts) if b.type == T.DATE else b
+            return Call(T.BIGINT, "$ts_diff",
+                        (bu, au, Literal(T.BIGINT, scale)))
         raise AnalysisError(f"{name} not supported yet")
 
     # -- subqueries ----------------------------------------------------
