@@ -14,7 +14,6 @@ contract.
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional
 
 from .. import session_properties as SP
@@ -50,18 +49,6 @@ class DistributedQueryRunner:
             catalog=next(iter(connectors), None))
         self.n_workers = n_workers if n_workers is not None \
             else SP.value(self.session, "task_concurrency")
-        # Task THREADS are capped by physical cores: n_workers sets the
-        # partitioning (task count / mesh width), but running more
-        # dispatching threads than cores adds no parallelism and can
-        # deadlock the XLA CPU client's core-sized thread pools (observed
-        # on 1-core hosts: 8 threads concurrently dispatching onto an
-        # 8-virtual-device client starve each other's async executes).
-        # Real deployments put tasks in separate processes anyway
-        # (reference: one TaskExecutor per worker JVM).
-        import os as _os
-
-        self.pool_threads = max(1, min(self.n_workers,
-                                       _os.cpu_count() or 1))
         self.desired_splits = desired_splits
         self.broadcast_threshold = broadcast_threshold \
             if broadcast_threshold is not None \
@@ -136,17 +123,23 @@ class DistributedQueryRunner:
         self._collect_stats = collect_stats
         t0 = _time.perf_counter()
 
-        with ThreadPoolExecutor(max_workers=self.pool_threads) as pool:
-            for frag in fragments:
-                ntasks = 1 if frag.partitioning == "single" \
-                    else self.n_workers
-                if frag.output_kind == "output":
-                    collected = self._run_output_fragment(
-                        pool, frag, root, ntasks, buffers)
-                    result_pages = collected
-                else:
-                    buffers[frag.fragment_id] = self._run_fragment(
-                        pool, frag, ntasks, buffers)
+        # tasks run as cooperative generators on the process-wide
+        # TaskExecutor: concurrent queries time-share the pool through
+        # the multilevel feedback queue instead of each query pinning
+        # its own threads (reference: TaskExecutor.java per worker JVM)
+        from ..exec.task_executor import shared_executor
+
+        executor = shared_executor()
+        for frag in fragments:
+            ntasks = 1 if frag.partitioning == "single" \
+                else self.n_workers
+            if frag.output_kind == "output":
+                collected = self._run_output_fragment(
+                    executor, frag, root, ntasks, buffers)
+                result_pages = collected
+            else:
+                buffers[frag.fragment_id] = self._run_fragment(
+                    executor, frag, ntasks, buffers)
 
         rows: List[tuple] = []
         for p in result_pages:
@@ -199,7 +192,7 @@ class DistributedQueryRunner:
             return None
         return DeviceExchange(self.n_workers, devices)
 
-    def _run_fragment(self, pool, frag: PlanFragment, ntasks: int,
+    def _run_fragment(self, executor, frag: PlanFragment, ntasks: int,
                       buffers: Dict[int, OutputBuffer]):
         # consumer partition count: single -> 1, hash -> n_workers,
         # broadcast -> replicated
@@ -218,7 +211,7 @@ class DistributedQueryRunner:
         stage = StageStatsTree(frag.fragment_id, frag.partitioning,
                                frag.output_kind)
 
-        def run_task(t: int):
+        def task_gen(t: int):
             planner = LocalExecutionPlanner(
                 self.metadata, self.desired_splits, task_id=t,
                 task_count=ntasks,
@@ -248,19 +241,24 @@ class DistributedQueryRunner:
             task = TaskStatsTree(t)
             for p in planner.pipelines:
                 d = Driver(p.operators, collect_stats=collect)
-                d.run_to_completion()
+                for _ in range(1_000_000):
+                    if d.process():
+                        break
+                    yield  # quantum boundary: hand the thread back
+                else:
+                    raise RuntimeError("driver did not finish")
                 if collect:
                     task.operators.extend(d.stats)
             if collect:
                 stage.tasks.append(task)
 
-        list(pool.map(run_task, range(ntasks)))
+        executor.run_all([task_gen(t) for t in range(ntasks)])
         if getattr(self, "_collect_stats", False):
             stage.tasks.sort(key=lambda t: t.task_id)
             self._stage_stats.append(stage)
         return out
 
-    def _run_output_fragment(self, pool, frag: PlanFragment,
+    def _run_output_fragment(self, executor, frag: PlanFragment,
                              root: OutputNode, ntasks: int,
                              buffers) -> List[Page]:
         from ..exec.stats import StageStatsTree, TaskStatsTree
@@ -269,7 +267,7 @@ class DistributedQueryRunner:
         stage = StageStatsTree(frag.fragment_id, frag.partitioning,
                                frag.output_kind)
 
-        def run_task(t: int):
+        def task_gen(t: int):
             planner = LocalExecutionPlanner(
                 self.metadata, self.desired_splits, task_id=t,
                 task_count=ntasks,
@@ -282,14 +280,25 @@ class DistributedQueryRunner:
             plan = planner.plan(OutputNode(frag.root, root.column_names,
                                            root.outputs))
             collect = getattr(self, "_collect_stats", False)
-            results[t] = plan.execute(collect_stats=collect)
-            if collect:
-                task = TaskStatsTree(t)
-                for d in plan.drivers:
+            from ..exec.driver import Driver
+
+            task = TaskStatsTree(t)
+            pages: List[Page] = []
+            for p in plan.pipelines:
+                d = Driver(p.operators, collect_stats=collect)
+                for _ in range(1_000_000):
+                    if d.process():
+                        break
+                    yield
+                else:
+                    raise RuntimeError("driver did not finish")
+                if collect:
                     task.operators.extend(d.stats)
+            results[t] = plan.sink.pages
+            if collect:
                 stage.tasks.append(task)
 
-        list(pool.map(run_task, range(ntasks)))
+        executor.run_all([task_gen(t) for t in range(ntasks)])
         if getattr(self, "_collect_stats", False):
             stage.tasks.sort(key=lambda t: t.task_id)
             self._stage_stats.append(stage)
