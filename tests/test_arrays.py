@@ -1,0 +1,125 @@
+"""ARRAY type (pooled composites), UNNEST, CHAR(n) padding.
+
+Reference analog: ``spi/type/ArrayType`` + ``operator/unnest/`` +
+ArrayFunctions/ArraySubscriptOperator tests. Arrays here are the
+string strategy generalized: device lanes hold int32 codes into a host
+pool of tuples, so grouping/joins/sorting run on codes/ranks and
+element access is a LUT gather.
+"""
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.parallel.distributed import DistributedQueryRunner
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=2048)},
+                            Session(catalog="tpch", schema="micro"))
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+def test_array_literal_and_functions(runner):
+    assert q(runner, "select array[1,2,3]") == [([1, 2, 3],)]
+    assert q(runner, "select cardinality(array[1,2,3]), "
+                     "array[10,20,30][2], element_at(array[1], 5), "
+                     "contains(array['a','b'], 'b'), "
+                     "array_join(array['x','y'], '-'), "
+                     "array_min(array[3,1,2]), "
+                     "array_max(array['a','c'])") == \
+        [(3, 20, None, True, "x-y", 1, "c")]
+
+
+def test_split_and_subscript_on_column(runner):
+    rows = q(runner, "select split(n_name, ' ')[1] from nation "
+                     "where n_nationkey in (23, 24) order by n_nationkey")
+    assert rows == [("UNITED",), ("UNITED",)]
+    rows = q(runner, "select split(n_name, ' ') from nation "
+                     "where n_nationkey = 23")
+    assert rows == [(["UNITED", "KINGDOM"],)]
+
+
+def test_array_equality_and_grouping(runner):
+    assert q(runner, "select array[1,2] = array[1,2], "
+                     "array[1,2] = array[1,3]") == [(True, False)]
+    rows = q(runner, """
+        select split(n_name, ' ')[1] w, count(*) c from nation
+        group by 1 order by c desc, w limit 1""")
+    assert rows == [("UNITED", 2)]
+
+
+def test_unnest_standalone(runner):
+    assert q(runner, "select * from unnest(array[1,2,3]) t(x)") == \
+        [(1,), (2,), (3,)]
+    assert q(runner, "select x, o from unnest(array['a','b']) "
+                     "with ordinality t(x, o)") == [("a", 1), ("b", 2)]
+    # multi-array zip pads the shorter with NULL
+    assert q(runner, "select * from unnest(array[1,2], "
+                     "array['a','b','c']) t(x, y)") == \
+        [(1, "a"), (2, "b"), (None, "c")]
+
+
+def test_unnest_correlated(runner):
+    rows = q(runner, """
+        select n_name, w from nation
+        cross join unnest(split(n_name, ' ')) t(w)
+        where n_nationkey = 23""")
+    assert rows == [("UNITED KINGDOM", "UNITED"),
+                    ("UNITED KINGDOM", "KINGDOM")]
+    rows = q(runner, """
+        select w, count(*) c from nation
+        cross join unnest(split(n_name, ' ')) t(w)
+        group by w order by c desc, w limit 2""")
+    assert rows == [("UNITED", 2), ("ALGERIA", 1)]
+
+
+def test_array_wire_serde():
+    from trino_tpu.block import Block, Page
+    from trino_tpu.exec.serde import PageDeserializer, PageSerializer
+
+    t = T.array_type(T.BIGINT)
+    page = Page([Block.from_pylist(t, [(1, 2), (3,), None])], 3)
+    out = PageDeserializer().deserialize(PageSerializer().serialize(page))
+    assert out.to_rows() == [([1, 2],), ([3],), (None,)]
+
+
+def test_char_padding_semantics(runner):
+    rows = q(runner, "select cast('ab' as char(5)), "
+                     "cast('abcdefgh' as char(3))")
+    assert rows == [("ab   ", "abc")]
+    # equal-length CHARs compare by padded value: trailing spaces in
+    # the source don't matter
+    assert q(runner, "select cast('x' as char(3)) = "
+                     "cast('x  ' as char(3))") == [(True,)]
+
+
+def test_array_type_parsing():
+    t = T.parse_type("array(bigint)")
+    assert t.is_array and t.element == T.BIGINT
+    assert T.parse_type("array(varchar)").element.is_string
+
+
+def test_derived_string_grouping_regression(runner):
+    """Grouping on a DERIVED string (aligned pool: one value, many
+    codes) must group by value, not raw code."""
+    rows = q(runner, """
+        select substr(n_name, 1, 1) c, count(*) n from nation
+        group by 1 order by n desc, c limit 3""")
+    assert rows == [("I", 4), ("A", 2), ("C", 2)]
+    rows = q(runner, """
+        select upper(r_name) u, count(*) from region
+        group by 1 order by u""")
+    assert len(rows) == 5 and all(n == 1 for _, n in rows)
+    # window partitions share the rank-canonical contract
+    rows = q(runner, """
+        select distinct substr(n_name, 1, 1) c,
+               count(*) over (partition by substr(n_name, 1, 1)) n
+        from nation order by n desc, c limit 2""")
+    assert rows == [("I", 4), ("A", 2)]

@@ -90,15 +90,19 @@ class Dictionary:
         """Code for value or -1 if absent (no mutation)."""
         return self._index.get(value, -1)
 
-    def encode(self, strings: Sequence[Optional[str]]) -> np.ndarray:
-        """Encode strings to codes. NULL lanes get code 0 — they carry an
+    def encode(self, strings: Sequence[Optional[str]],
+               null_value="") -> np.ndarray:
+        """Encode values to codes. NULL lanes get code 0 — they carry an
         arbitrary valid code and MUST be masked by the block's null mask
-        (kernels fold the null bit into key comparisons explicitly)."""
+        (kernels fold the null bit into key comparisons explicitly).
+        ``null_value`` is the pool placeholder kept type-homogeneous
+        ("" for strings, () for arrays) so rank sorting never compares
+        across types."""
         out = np.empty(len(strings), dtype=np.int32)
         for i, s in enumerate(strings):
             if s is None:
                 if not self.values:
-                    self.code("")  # keep code 0 decodable on an empty pool
+                    self.code(null_value)  # keep code 0 decodable
                 out[i] = 0
             else:
                 out[i] = self.code(s)
@@ -114,8 +118,11 @@ class Dictionary:
         comparisons/grouping over ranks match string equality. Lets ORDER
         BY / GROUP BY on strings run on device via rank[codes]."""
         if self._sort_rank is None or len(self._sort_rank) != len(self.values):
-            _, inverse = np.unique(np.asarray(self.values, dtype=object),
-                                   return_inverse=True)
+            # np.asarray on equal-length tuples builds a 2-D array;
+            # assigning into an empty object array keeps entries intact
+            arr = np.empty(len(self.values), dtype=object)
+            arr[:] = self.values
+            _, inverse = np.unique(arr, return_inverse=True)
             self._sort_rank = inverse.astype(np.int32)
         return self._sort_rank
 
@@ -130,7 +137,7 @@ class Block:
     dictionary: Optional[Dictionary] = None
 
     def __post_init__(self):
-        if self.type.is_string and self.dictionary is None:
+        if self.type.is_pooled and self.dictionary is None:
             raise ValueError("string block requires a dictionary")
 
     def __len__(self) -> int:
@@ -176,8 +183,11 @@ class Block:
         b = self.numpy()
         data, t = b.data, b.type
         nulls = b.nulls_array() if b.nulls is not None else None
-        if t.is_string:
+        if t.is_pooled:
             raw = b.dictionary.decode(data)
+            if t.is_array:
+                # user-visible arrays are lists (pool entries are tuples)
+                raw = [None if v is None else list(v) for v in raw]
         elif t.is_decimal:
             raw = [t.from_raw(v) for v in data.tolist()]
         elif t.is_timestamp_tz:
@@ -213,9 +223,10 @@ class Block:
         n = len(values)
         nulls = np.fromiter((v is None for v in values), dtype=bool, count=n)
         has_nulls = bool(nulls.any())
-        if type_.is_string:
+        if type_.is_pooled:
             d = dictionary if dictionary is not None else Dictionary()
-            data = d.encode(values)
+            data = d.encode(values,
+                            null_value=() if type_.is_array else "")
             return Block(type_, data, nulls if has_nulls else None, d)
         data = np.empty(n, dtype=type_.storage)
         if type_.is_timestamp_tz:
@@ -300,7 +311,7 @@ class Page:
             parts = [p.block(c).numpy() for p in pages]
             t = parts[0].type
             dictionary = parts[0].dictionary
-            if t.is_string:
+            if t.is_pooled:
                 # Re-encode into the first block's dictionary when pools differ.
                 unified = []
                 for b in parts:
@@ -408,6 +419,6 @@ def empty_page(types_: Sequence[T.Type],
                dictionaries: Optional[Sequence] = None) -> Page:
     blocks = []
     for i, t in enumerate(types_):
-        d = (dictionaries[i] if dictionaries else None) or (Dictionary() if t.is_string else None)
+        d = (dictionaries[i] if dictionaries else None) or (Dictionary() if t.is_pooled else None)
         blocks.append(Block(t, np.empty(0, dtype=t.storage), None, d))
     return Page(blocks, 0)

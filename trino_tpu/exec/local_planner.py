@@ -167,6 +167,24 @@ class LocalExecutionPlanner:
         new_layout = {s.name: i for i, (s, _) in enumerate(node.assignments)}
         return ops, new_layout, [s.type for s, _ in node.assignments]
 
+    def _v_UnnestNode(self, node):
+        from ..ops.unnest import UnnestOperator
+
+        ops, layout, types_ = self.visit(node.source)
+        arr_chans = [layout[s.name] for s in node.array_symbols]
+        el_types = [s.type for s in node.element_symbols]
+        ops.append(UnnestOperator(types_, arr_chans, el_types,
+                                  node.ordinality_symbol is not None))
+        out_layout = dict(layout)
+        out_types = list(types_)
+        extra = list(node.element_symbols)
+        if node.ordinality_symbol is not None:
+            extra.append(node.ordinality_symbol)
+        for s in extra:
+            out_layout[s.name] = len(out_types)
+            out_types.append(s.type)
+        return ops, out_layout, out_types
+
     def _v_JoinNode(self, node: JoinNode):
         return self._plan_join(node.join_type, node.left, node.right,
                                node.criteria, node.filter_expr)

@@ -14,6 +14,7 @@ applied to the wire.
 
 from __future__ import annotations
 
+import json
 import struct
 import zlib
 from typing import Dict, List, Optional, Tuple
@@ -24,6 +25,30 @@ from .. import types as T
 from ..block import Block, Dictionary, Page
 
 _MAGIC = 0x54505047  # "TPPG"
+
+
+def _jsonable(v):
+    """Pool-entry -> JSON: tuples become tagged lists (nesting survives
+    the round trip as tuples, not lists) and Decimals become tagged
+    strings."""
+    from decimal import Decimal
+
+    if isinstance(v, tuple):
+        return ["t", [_jsonable(x) for x in v]]
+    if isinstance(v, Decimal):
+        return ["d", str(v)]
+    return ["v", v]
+
+
+def _from_jsonable(doc):
+    from decimal import Decimal
+
+    tag, payload = doc
+    if tag == "t":
+        return tuple(_from_jsonable(x) for x in payload)
+    if tag == "d":
+        return Decimal(payload)
+    return payload
 
 
 def _wire_signature(t: T.Type) -> str:
@@ -77,7 +102,14 @@ class PageSerializer:
                 # concurrently (Dictionary.code is thread-safe growth),
                 # and len(values) re-read here could exceed the slice
                 self._sent_pools[(ch, -pool_id)] = sent_len + len(delta)
-                enc = [v.encode() for v in delta]
+                if b.type.is_array:
+                    # composite pool entries (tuples) ship as JSON;
+                    # flag bit 4 tells the reader to decode them back
+                    enc = [json.dumps(_jsonable(v)).encode()
+                           for v in delta]
+                    flags |= 4
+                else:
+                    enc = [v.encode() for v in delta]
                 dict_payload = struct.pack("<III", pool_id, sent_len,
                                            len(enc))
                 dict_payload += b"".join(
@@ -142,7 +174,9 @@ class PageDeserializer:
                 for _ in range(n_delta):
                     (vlen,) = struct.unpack_from("<I", raw, off)
                     off += 4
-                    values.append(raw[off:off + vlen].decode())
+                    text = raw[off:off + vlen].decode()
+                    values.append(_from_jsonable(json.loads(text))
+                                  if flags & 4 else text)
                     off += vlen
                 dictionary = self._pools.get((ch, pool_id))
                 if dictionary is None:

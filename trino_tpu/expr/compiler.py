@@ -37,6 +37,11 @@ def _is_string(t: T.Type) -> bool:
     return t.is_string
 
 
+def _is_pooled(t: T.Type) -> bool:
+    """Strings AND arrays: device codes into a host value pool."""
+    return getattr(t, "is_pooled", False)
+
+
 class _StrView:
     """Plan-time view of a string-valued expression: codes come from one
     input channel (or a literal), values are a host transform chain over
@@ -117,8 +122,28 @@ class PageProcessor:
         if isinstance(e, Literal):
             return _StrView(literal=e.value)
         if isinstance(e, Call):
-            if e.name == "$cast" and _is_string(e.args[0].type):
-                return self._str_view(e.args[0])  # varchar(n) <-> varchar
+            if e.name == "$cast" and _is_pooled(e.args[0].type):
+                base = self._str_view(e.args[0])
+                if isinstance(e.type, T.CharType):
+                    # CHAR(n) semantics: fixed length, space padded —
+                    # comparisons then naturally ignore trailing-space
+                    # differences between CHARs of equal length
+                    n = e.type.length
+                    prev = base.transform
+
+                    def pad(s, _n=n, _prev=prev):
+                        if s is None:
+                            return None
+                        if _prev is not None:
+                            s = _prev(s)
+                            if s is None:
+                                return None
+                        return s[:_n].ljust(_n)
+
+                    if base.channel is None:
+                        return _StrView(literal=pad(base.literal))
+                    return _StrView(channel=base.channel, transform=pad)
+                return base  # varchar(n) <-> varchar: code passthrough
             fn = F.get_function(e.name)
             if fn.str_transform is None:
                 raise TypeError_(
@@ -126,7 +151,7 @@ class PageProcessor:
             base = None
             extra: List = []
             for a in e.args:
-                if _is_string(a.type):
+                if _is_pooled(a.type):
                     if base is not None:
                         # two string columns: only literal second arg works
                         v = self._str_view(a)
@@ -212,9 +237,10 @@ class PageProcessor:
                 z = np.zeros((), dtype=t.storage if t.storage is not None
                              else np.bool_)
                 return lambda env: (jnp.asarray(z), jnp.asarray(True))
-            if _is_string(t):
-                # projected string literal: code 0 into the one-entry
-                # dictionary process() resolves via _str_view
+            if _is_pooled(t):
+                # projected pooled literal (string/array): code 0 into
+                # the one-entry dictionary process() resolves via
+                # _str_view
                 return lambda env: (jnp.zeros((), dtype=jnp.int32), None)
             raw = self._literal_raw(e)
             return lambda env: (jnp.asarray(raw), None)
@@ -256,7 +282,7 @@ class PageProcessor:
 
         if name == "$is_null":
             arg = e.args[0]
-            if _is_string(arg.type):
+            if _is_pooled(arg.type):
                 if isinstance(arg, Call) and arg.name in (
                         "$if", "$case", "$coalesce"):
                     # nested string select: its own plan computes nulls
@@ -274,7 +300,7 @@ class PageProcessor:
 
         if name == "$coalesce":
             rt = e.type
-            if _is_string(rt):
+            if _is_pooled(rt):
                 # coalesce = first-non-null CASE over the branch views
                 conds = [Call(T.BOOLEAN, "$not",
                               (Call(T.BOOLEAN, "$is_null", (a,)),))
@@ -322,21 +348,24 @@ class PageProcessor:
 
         fn = F.get_function(name)
 
-        # string comparisons -> rank LUTs
+        # pooled-value comparisons (strings, arrays) -> rank LUTs
         if name in ("eq", "ne", "lt", "le", "gt", "ge") and \
-                any(_is_string(a.type) for a in e.args):
+                any(_is_pooled(a.type) for a in e.args):
             return self._plan_string_cmp(e)
 
-        # host string functions -> LUT gather
-        if fn.str_scalar is not None and _is_string(e.args[0].type):
-            return self._plan_str_scalar(e, fn)
-        if fn.str_transform is not None and _is_string(e.type):
-            # string-valued: consumed by an outer string op or projection;
-            # evaluation happens via _str_view there. Standalone eval means
-            # a projection — handled in process(); here return codes.
+        # host pool functions -> LUT gather. Pooled OUTPUT dispatches on
+        # str_transform first: a function registered with both (array
+        # subscript) is a transform when its result is pooled, a scalar
+        # LUT otherwise.
+        if fn.str_transform is not None and _is_pooled(e.type):
+            # pool-valued: consumed by an outer pool op or projection;
+            # evaluation happens via _str_view there. Standalone eval
+            # means a projection — handled in process(); return codes.
             codes = self._plan_str_codes(e)
             nulls = self._string_nulls_plan(e)
             return lambda env: (codes(env), _nz(nulls(env)))
+        if fn.str_scalar is not None and _is_pooled(e.args[0].type):
+            return self._plan_str_scalar(e, fn)
 
         return self._plan_default_call(e, fn)
 
@@ -404,20 +433,45 @@ class PageProcessor:
             lit_args.append(a.value)
         rt = e.type
 
+        memo: Dict = {}
+
+        def results(dicts):
+            # ONE host pass shared by both slots (value + None mask)
+            key = (id(dicts[view.channel]),
+                   len(dicts[view.channel] or ())) \
+                if view.channel is not None else ("lit",)
+            hit = memo.get(key)
+            if hit is None:
+                vals = view.values(dicts)
+                hit = [None if v is None
+                       else fn.str_scalar(v, *lit_args) for v in vals]
+                memo.clear()
+                memo[key] = hit
+            return hit
+
         def fill(dicts):
-            vals = view.values(dicts)
-            out = np.zeros(len(vals), dtype=rt.storage)
-            for i, v in enumerate(vals):
-                if v is not None:
-                    out[i] = fn.str_scalar(v, *lit_args)
+            res = results(dicts)
+            out = np.zeros(len(res), dtype=rt.storage)
+            for i, r in enumerate(res):
+                if r is not None:
+                    out[i] = r
             return out
 
+        def fill_none(dicts):
+            # a None RESULT on a non-null input is SQL NULL (e.g. array
+            # subscript out of range)
+            return np.asarray([r is None for r in results(dicts)],
+                              dtype=np.bool_)
+
         slot = self._new_slot(fill, rt.storage)
+        none_slot = self._new_slot(fill_none, np.bool_)
         codes = self._plan_str_codes(base)
         nulls = self._string_nulls_plan(base)
 
         def ev(env):
-            return env["luts"][slot][codes(env)], _nz_opt(nulls(env))
+            c = codes(env)
+            null = _merge_nulls(nulls(env), env["luts"][none_slot][c])
+            return env["luts"][slot][c], null
 
         return ev
 
@@ -493,7 +547,7 @@ class PageProcessor:
             conds = pairs[0::2]
             vals = pairs[1::2]
         rt = e.type
-        if _is_string(rt):
+        if _is_pooled(rt):
             return self._plan_string_select(e, conds, vals, default)
         cond_plans = [self._plan(c) for c in conds]
         val_plans = [self._plan(v) for v in vals]
@@ -579,11 +633,13 @@ class PageProcessor:
 
         self._out_dict_resolvers[id(e)] = merged_dict
 
+        null_pool_value = () if getattr(e.type, "is_array", False) else ""
+
         def code_slot(view: _StrView) -> int:
             if view.channel is None:
                 def fill_lit(dicts, _v=view.literal):
                     m = merged_dict(dicts)
-                    code = m.code("" if _v is None else _v)
+                    code = m.code(null_pool_value if _v is None else _v)
                     return np.asarray([code], dtype=np.int32)
 
                 return self._new_slot(fill_lit, np.int32)
@@ -591,9 +647,11 @@ class PageProcessor:
             def fill(dicts, _view=view):
                 m = merged_dict(dicts)
                 vals_ = _view.values(dicts)
-                arr = [m.code("" if v is None else v) for v in vals_]
+                arr = [m.code(null_pool_value if v is None else v)
+                       for v in vals_]
                 # empty input pool: one dead entry keeps the gather legal
-                return np.asarray(arr or [m.code("")], dtype=np.int32)
+                return np.asarray(arr or [m.code(null_pool_value)],
+                                  dtype=np.int32)
 
             return self._new_slot(fill, np.int32)
 
@@ -659,7 +717,7 @@ class PageProcessor:
     def _plan_cast(self, e: Call):
         src = e.args[0]
         st, rt = src.type, e.type
-        if _is_string(st) and _is_string(rt):
+        if _is_pooled(st) and _is_pooled(rt):
             codes = self._plan_str_codes(src)
             nulls = self._string_nulls_plan(src)
             return lambda env: (codes(env), _nz_opt(nulls(env)))
@@ -770,7 +828,7 @@ class PageProcessor:
             tuple(dpage.cols), tuple(dpage.nulls), dpage.valid, luts)
         out_dicts = []
         for j, proj in enumerate(self.projections):
-            if _is_string(proj.type):
+            if _is_pooled(proj.type):
                 resolver = self._out_dict_resolvers.get(id(proj))
                 if resolver is not None:
                     out_dicts.append(resolver(dicts))
@@ -794,10 +852,12 @@ class PageProcessor:
                     d = self._dict_cache.get(key)
                     if d is None:
                         vals = view.values(dicts)
+                        npv = () if getattr(proj.type, "is_array",
+                                            False) else ""
                         # pool must stay code-aligned with the input pool
                         # (derived values may repeat), so no dedup here
                         d = Dictionary.aligned(
-                            ["" if v is None else v for v in vals])
+                            [npv if v is None else v for v in vals])
                         self._dict_cache[key] = d
                     out_dicts.append(d)
             else:

@@ -817,10 +817,11 @@ def _truncate_kernel(raws, arg_types, ret_type):
     if t in (T.REAL, T.DOUBLE):
         f = jnp.power(10.0, n.astype(jnp.float64))
         return jnp.trunc(x.astype(jnp.float64) * f) / f
-    if t.is_decimal and t.scale:
-        # zero digits beyond n decimal places, toward zero
-        keep = jnp.clip(jnp.int64(t.scale) - n, 0, t.scale)
-        f = (10 ** keep.astype(jnp.float64)).astype(jnp.int64)
+    if t.is_decimal and t.scale is not None:
+        # zero digits beyond n decimal places, toward zero; negative n
+        # zeroes digits LEFT of the point (f grows past the scale)
+        keep = jnp.clip(jnp.int64(t.scale) - n, 0, 18)
+        f = (10.0 ** keep.astype(jnp.float64)).astype(jnp.int64)
         return jnp.sign(x) * (jnp.abs(x) // f) * f
     return x
 
@@ -1028,3 +1029,92 @@ def _ts_diff_kernel(raws, arg_types, ret_type):
 
 
 register(ScalarFunction("$ts_diff", _resolve_ts_diff, _ts_diff_kernel))
+
+
+# ---------------------------------------------------------------------------
+# arrays (pooled composites; reference: operator/scalar/ArrayFunctions +
+# ArraySubscriptOperator — here host pool LUTs like the string strategy)
+
+
+def _resolve_cardinality(args):
+    if not args[0].is_array:
+        raise TypeError_(f"cardinality expects array, got {args[0]}")
+    return T.BIGINT
+
+
+register(ScalarFunction("cardinality", _resolve_cardinality,
+                        str_scalar=lambda a: len(a)))
+
+
+def _element_of(a, i):
+    i = int(i)
+    return a[i - 1] if 1 <= i <= len(a) else None
+
+
+def _resolve_element_at(args):
+    if not args[0].is_array:
+        raise TypeError_(f"element_at expects array, got {args[0]}")
+    if not _is_int(args[1]):
+        raise TypeError_("element_at index must be an integer")
+    return args[0].element
+
+
+# $subscript is emitted by the analyzer for base[i]; element_at is the
+# two-arg function form — same host lookup (1-based, out of range NULL)
+register(ScalarFunction("$subscript", _resolve_element_at,
+                        str_scalar=_element_of, str_transform=_element_of))
+register(ScalarFunction("element_at", _resolve_element_at,
+                        str_scalar=_element_of, str_transform=_element_of))
+
+
+def _resolve_contains(args):
+    if not args[0].is_array:
+        raise TypeError_(f"contains expects array, got {args[0]}")
+    return T.BOOLEAN
+
+
+register(ScalarFunction("contains", _resolve_contains,
+                        str_scalar=lambda a, x: x in a))
+
+
+def _resolve_split(args):
+    if not (args[0].is_string and args[1].is_string):
+        raise TypeError_("split expects (varchar, varchar)")
+    return T.array_type(T.VARCHAR)
+
+
+register(ScalarFunction("split", _resolve_split,
+                        str_transform=lambda s, d: tuple(s.split(d))))
+
+
+def _resolve_array_join(args):
+    if not args[0].is_array:
+        raise TypeError_(f"array_join expects array, got {args[0]}")
+    return T.VARCHAR
+
+
+register(ScalarFunction(
+    "array_join", _resolve_array_join,
+    str_transform=lambda a, sep, nullrepl=None: sep.join(
+        (nullrepl if v is None else str(v))
+        for v in a if v is not None or nullrepl is not None)))
+
+
+def _resolve_array_minmax(args):
+    if not args[0].is_array:
+        raise TypeError_(f"expected array, got {args[0]}")
+    return args[0].element
+
+
+register(ScalarFunction(
+    "array_min", _resolve_array_minmax,
+    str_scalar=lambda a: min((v for v in a if v is not None),
+                             default=None),
+    str_transform=lambda a: min((v for v in a if v is not None),
+                                default=None)))
+register(ScalarFunction(
+    "array_max", _resolve_array_minmax,
+    str_scalar=lambda a: max((v for v in a if v is not None),
+                             default=None),
+    str_transform=lambda a: max((v for v in a if v is not None),
+                                default=None)))

@@ -462,9 +462,19 @@ class HashAggregationOperator(Operator):
         key_ops: List = []
         key_raws: List = []
         for c, t in zip(key_channels, key_types):
-            ops = group_operands(page.cols[c], page.nulls[c], t)
+            col = page.cols[c]
+            if getattr(t, "is_pooled", False):
+                # group pooled keys by lexicographic RANK, not raw code:
+                # aligned (derived) pools may hold one value under
+                # several codes. The representative raw code still rides
+                # along for output.
+                rank_lut, _ = _rank_and_inverse(page.dictionaries[c])
+                ops = group_operands(jnp.asarray(rank_lut)[col],
+                                     page.nulls[c], T.BIGINT)
+            else:
+                ops = group_operands(col, page.nulls[c], t)
             key_ops.extend(ops)
-            key_raws.append(page.cols[c])
+            key_raws.append(col)
 
         if intermediate:
             # states laid out after the keys

@@ -1,0 +1,139 @@
+"""UNNEST: expand pooled array columns to one row per element.
+
+Reference analog: ``operator/unnest/UnnestOperator.java`` (12 files of
+per-type unnesters). TPU redesign: arrays are dictionary codes, so the
+expansion is the join-expansion pattern — per-row element counts come
+from a host length-LUT over the pool, lanes expand with the cumsum/
+searchsorted trick, and element values gather from a FLATTENED element
+LUT (elements of pool entry c live at flat[offset[c] .. offset[c] +
+len(c))). Varchar elements re-encode into a fresh element pool.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..block import DevicePage, Dictionary, padded_size
+from .operator import Operator
+
+
+class UnnestOperator(Operator):
+    def __init__(self, input_types: Sequence[T.Type],
+                 array_channels: Sequence[int],
+                 element_types: Sequence[T.Type],
+                 with_ordinality: bool = False):
+        self.input_types = list(input_types)
+        self.array_channels = list(array_channels)
+        self.element_types = list(element_types)
+        self.with_ordinality = with_ordinality
+        self._pending: Optional[DevicePage] = None
+        self._done = False
+        self._luts: Dict = {}  # (chan, id(dict), len) -> lut bundle
+
+    @property
+    def output_types(self) -> List[T.Type]:
+        out = list(self.input_types) + list(self.element_types)
+        if self.with_ordinality:
+            out.append(T.BIGINT)
+        return out
+
+    def needs_input(self) -> bool:
+        return self._pending is None and not self._finishing
+
+    def _channel_luts(self, chan: int, d: Optional[Dictionary],
+                      et: T.Type):
+        """(len_lut, offset_lut, flat_values, element_dict): per-code
+        array length, flat offset, and the flattened element payload."""
+        key = (chan, id(d), len(d) if d is not None else 0)
+        hit = self._luts.get(key)
+        if hit is not None:
+            return hit[:4]
+        values = d.values if d is not None else []
+        lens = np.asarray([len(v) for v in values] or [0],
+                          dtype=np.int64)
+        offsets = np.concatenate([[0], np.cumsum(lens)[:-1]]) \
+            if len(lens) else np.zeros(1, dtype=np.int64)
+        flat: List = []
+        for v in values:
+            flat.extend(v)
+        edict = None
+        if et.is_pooled:
+            edict = Dictionary()
+            flat_vals = edict.encode(flat)
+            enull = np.asarray([v is None for v in flat] or [False],
+                               dtype=bool)
+        else:
+            flat_vals = np.zeros(max(len(flat), 1), dtype=et.storage)
+            enull = np.zeros(max(len(flat), 1), dtype=bool)
+            for i, v in enumerate(flat):
+                if v is None:
+                    enull[i] = True
+                elif et.is_decimal:
+                    flat_vals[i] = et.to_raw(v)
+                else:
+                    flat_vals[i] = v
+        bundle = (jnp.asarray(lens), jnp.asarray(offsets.astype(np.int64)),
+                  (jnp.asarray(flat_vals), jnp.asarray(enull)), edict, d)
+        if len(self._luts) >= 128:
+            self._luts.clear()
+        self._luts[key] = bundle
+        return bundle[:4]
+
+    def add_input(self, page: DevicePage):
+        n = page.valid.shape[0]
+        per_chan = []
+        counts = jnp.zeros(n, dtype=jnp.int64)
+        for ch, et in zip(self.array_channels, self.element_types):
+            lens, offsets, flat, edict = self._channel_luts(
+                ch, page.dictionaries[ch], et)
+            live = page.valid & ~page.nulls[ch]
+            clen = jnp.where(live, lens[page.cols[ch]], 0)
+            counts = jnp.maximum(counts, clen)
+            per_chan.append((ch, clen, offsets, flat, edict))
+        total = int(jnp.sum(counts))  # one scalar sync per page
+        cap = padded_size(max(total, 16))
+        probe_idx, within, lane_valid = _expand_with_pos(counts, cap)
+
+        out_cols = [c[probe_idx] for c in page.cols]
+        out_nulls = [x[probe_idx] for x in page.nulls]
+        out_dicts = list(page.dictionaries)
+        for (ch, clen, offsets, (flat_vals, flat_null), edict), et in zip(
+                per_chan, self.element_types):
+            pos = offsets[page.cols[ch][probe_idx]] + within
+            pos = jnp.clip(pos, 0, flat_vals.shape[0] - 1)
+            in_arr = within < clen[probe_idx]
+            out_cols.append(flat_vals[pos].astype(et.storage))
+            out_nulls.append(~in_arr | flat_null[pos])
+            out_dicts.append(edict)
+        if self.with_ordinality:
+            out_cols.append(within + 1)
+            out_nulls.append(jnp.zeros(cap, dtype=bool))
+            out_dicts.append(None)
+        self._pending = DevicePage(self.output_types, out_cols, out_nulls,
+                                   lane_valid, out_dicts)
+
+    def get_output(self) -> Optional[DevicePage]:
+        out, self._pending = self._pending, None
+        if out is None and self._finishing:
+            self._done = True
+        return out
+
+    def is_finished(self) -> bool:
+        return self._done
+
+
+def _expand_with_pos(counts, cap: int):
+    """lane j -> (source row, position within that row's expansion)."""
+    off_end = jnp.cumsum(counts)
+    total = off_end[-1]
+    j = jnp.arange(cap, dtype=jnp.int64)
+    row = jnp.searchsorted(off_end, j, side="right")
+    row = jnp.clip(row, 0, counts.shape[0] - 1)
+    start = off_end[row] - counts[row]
+    within = j - start
+    lane_valid = j < total
+    return row.astype(jnp.int32), within, lane_valid

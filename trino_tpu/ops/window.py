@@ -390,8 +390,19 @@ class WindowOperator(Operator):
         page = _concat_pages(self._pages, cap)
         part_ops: List = []
         for c in self.partition_channels:
-            part_ops.extend(group_operands(page.cols[c], page.nulls[c],
-                                           page.types[c]))
+            t = page.types[c]
+            if getattr(t, "is_pooled", False):
+                # partition pooled keys by value RANK (derived pools may
+                # alias one value under several codes)
+                from .aggregation import _rank_and_inverse
+
+                rank_lut, _ = _rank_and_inverse(page.dictionaries[c])
+                part_ops.extend(group_operands(
+                    jnp.asarray(rank_lut)[page.cols[c]],
+                    page.nulls[c], T.BIGINT))
+            else:
+                part_ops.extend(group_operands(page.cols[c],
+                                               page.nulls[c], t))
         order_ops: List = []
         for k in self.sort_keys:
             order_ops.extend(sort_operands(

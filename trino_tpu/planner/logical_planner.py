@@ -262,6 +262,8 @@ class QueryPlanner:
                       outer_scope: Optional[Scope]) -> RelationPlan:
         if isinstance(rel, ast.Table):
             return self.plan_table(rel, outer_scope)
+        if isinstance(rel, ast.Unnest):
+            return self.plan_unnest(rel, None, outer_scope)
         if isinstance(rel, ast.AliasedRelation):
             rp = self.plan_relation(rel.relation, outer_scope)
             fields = []
@@ -344,8 +346,56 @@ class QueryPlanner:
         return RelationPlan(ValuesNode(symbols, rows),
                             Scope(fields, outer_scope))
 
+    def plan_unnest(self, un: ast.Unnest,
+                    base: Optional["RelationPlan"],
+                    outer_scope: Optional[Scope],
+                    alias: Optional[str] = None,
+                    column_names=()) -> RelationPlan:
+        """UNNEST as a relation: standalone (FROM unnest(...)) or
+        correlated to the left side of a CROSS JOIN (reference:
+        RelationPlanner.planCrossJoinUnnest)."""
+        from .plan import UnnestNode
+
+        if base is None:
+            base = RelationPlan(ValuesNode([], [[]]),
+                                Scope([], outer_scope))
+        analyzer = ExpressionAnalyzer(base.scope, self.ctx.session)
+        node = base.node
+        arr_syms: List[Symbol] = []
+        el_syms: List[Symbol] = []
+        for expr in un.expressions:
+            e = analyzer.analyze(expr)
+            if not e.type.is_array:
+                raise AnalysisError(
+                    f"UNNEST argument must be an array, got {e.type}")
+            node, s = _ensure_symbol(self, node, e, None)
+            arr_syms.append(s)
+            el_syms.append(self.allocator.new_symbol(
+                "unnest", e.type.element))
+        ord_sym = self.allocator.new_symbol("ordinality", T.BIGINT) \
+            if un.with_ordinality else None
+        out = UnnestNode(node, arr_syms, el_syms, ord_sym)
+        new = el_syms + ([ord_sym] if ord_sym else [])
+        names = [column_names[i].lower() if i < len(column_names)
+                 else None for i in range(len(new))]
+        fields = base.scope.fields + [
+            FieldDef(names[i], s, relation_alias=(alias or "").lower()
+                     or None)
+            for i, s in enumerate(new)]
+        return RelationPlan(out, Scope(fields, outer_scope))
+
     def plan_join(self, rel: ast.Join,
                   outer_scope: Optional[Scope]) -> RelationPlan:
+        if rel.join_type.upper() in ("CROSS", "IMPLICIT"):
+            r = rel.right
+            alias, cols = None, ()
+            if isinstance(r, ast.AliasedRelation) \
+                    and isinstance(r.relation, ast.Unnest):
+                alias, cols = r.alias, r.column_names
+                r = r.relation
+            if isinstance(r, ast.Unnest):
+                left = self.plan_relation(rel.left, outer_scope)
+                return self.plan_unnest(r, left, outer_scope, alias, cols)
         left = self.plan_relation(rel.left, outer_scope)
         right = self.plan_relation(rel.right, outer_scope)
         jt = rel.join_type.upper()

@@ -442,11 +442,14 @@ def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
     if isinstance(node, OutputNode):
         return OutputNode(sources[0], node.column_names, node.outputs)
     from .plan import (ExchangeNode, RemoteSourceNode, TableWriterNode,
-                       WindowNode)
+                       UnnestNode, WindowNode)
 
     if isinstance(node, WindowNode):
         return WindowNode(sources[0], node.partition_by, node.orderings,
                           node.functions)
+    if isinstance(node, UnnestNode):
+        return UnnestNode(sources[0], node.array_symbols,
+                          node.element_symbols, node.ordinality_symbol)
     if isinstance(node, TableWriterNode):
         return TableWriterNode(sources[0], node.catalog, node.schema,
                                node.table_name, node.columns,

@@ -342,6 +342,29 @@ class WindowNode(PlanNode):
 
 
 @dataclass
+class UnnestNode(PlanNode):
+    """Expand array columns to one row per element (reference:
+    sql/planner/plan/UnnestNode.java). Source rows replicate; multiple
+    arrays zip (shorter ones pad with NULL)."""
+
+    source: PlanNode
+    array_symbols: List[Symbol]      # input array columns
+    element_symbols: List[Symbol]    # one output element column each
+    ordinality_symbol: Optional[Symbol] = None
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        out = list(self.source.output_symbols) + list(self.element_symbols)
+        if self.ordinality_symbol is not None:
+            out.append(self.ordinality_symbol)
+        return out
+
+
+@dataclass
 class TableWriterNode(PlanNode):
     """Write query output to a connector sink; emits one row with the
     written-row count (reference: plan/TableWriterNode.java +

@@ -429,6 +429,34 @@ class ExpressionAnalyzer:
         utc = wall_to_utc_host(days * 86_400_000_000, zone)
         return Literal(T.timestamp_tz_type(zone), utc)
 
+    def _an_ArrayConstructor(self, e):
+        """ARRAY literal -> pooled value (a python tuple in the code
+        pool). Elements must fold to literals: per-row array
+        construction would need host work per row."""
+        elems = [self.analyze(x) for x in e.elements]
+        et = T.UNKNOWN
+        for el in elems:
+            et = common_type(et, el.type, "ARRAY")
+        vals = []
+        for el in elems:
+            el = coerce(el, et)
+            if not isinstance(el, Literal):
+                raise AnalysisError(
+                    "ARRAY elements must be literals (per-row array "
+                    "construction is not supported)")
+            vals.append(el.value)
+        return Literal(T.array_type(et), tuple(vals))
+
+    def _an_Subscript(self, e):
+        base = self.analyze(e.base)
+        idx = self.analyze(e.index)
+        if not base.type.is_array:
+            raise AnalysisError(
+                f"subscript requires an array, got {base.type}")
+        if not isinstance(idx, Literal):
+            raise AnalysisError("array subscript must be a literal")
+        return Call(base.type.element, "$subscript", (base, idx))
+
     def _an_AtTimeZone(self, e):
         from ..expr import tz as _tz
 

@@ -70,6 +70,21 @@ class GenericLiteral(Expression):
 
 
 @dataclass(frozen=True)
+class ArrayConstructor(Expression):
+    """``ARRAY[e1, e2, ...]`` (reference: sql/tree/ArrayConstructor)."""
+
+    elements: Tuple[Expression, ...]
+
+
+@dataclass(frozen=True)
+class Subscript(Expression):
+    """``base[index]`` (reference: sql/tree/SubscriptExpression)."""
+
+    base: Expression
+    index: Expression
+
+
+@dataclass(frozen=True)
 class AtTimeZone(Expression):
     """``value AT TIME ZONE 'zone'`` (reference: sql/tree/AtTimeZone.java)."""
 

@@ -740,13 +740,22 @@ class Parser:
 
     def _primary_with_suffix(self):
         e = self._primary()
-        while self.at_op("."):
-            # dereference (alias.column)
-            if isinstance(e, (ast.Identifier, ast.DereferenceExpression)):
-                self.advance()
-                e = ast.DereferenceExpression(e, self.identifier())
-            else:
+        while True:
+            if self.at_op("."):
+                # dereference (alias.column)
+                if isinstance(e, (ast.Identifier,
+                                  ast.DereferenceExpression)):
+                    self.advance()
+                    e = ast.DereferenceExpression(e, self.identifier())
+                    continue
                 break
+            if self.at_op("["):
+                self.advance()
+                idx = self._expression()
+                self.expect_op("]")
+                e = ast.Subscript(e, idx)
+                continue
+            break
         return e
 
     def _primary(self) -> ast.Expression:
@@ -764,6 +773,17 @@ class Parser:
         if t.kind == "op" and t.value == "?":
             self.advance()
             return ast.Parameter(0)
+        if t.kind == "ident" and t.value == "array" \
+                and self.peek().kind == "op" and self.peek().value == "[":
+            self.advance()
+            self.advance()
+            elements = []
+            if not self.at_op("]"):
+                elements.append(self._expression())
+                while self.accept_op(","):
+                    elements.append(self._expression())
+            self.expect_op("]")
+            return ast.ArrayConstructor(tuple(elements))
         if t.kind == "op" and t.value == "(":
             self.advance()
             if self.at_kw("select", "with"):

@@ -62,6 +62,16 @@ class Type:
     def is_timestamp_tz(self) -> bool:
         return False
 
+    @property
+    def is_array(self) -> bool:
+        return False
+
+    @property
+    def is_pooled(self) -> bool:
+        """Device storage is int32 codes into a host-side value pool
+        (strings and arrays); kernels see only the codes."""
+        return self.is_string or self.is_array
+
     def zero(self):
         """Neutral raw storage value used for padding lanes."""
         return np.zeros((), dtype=self.storage)[()]
@@ -195,14 +205,21 @@ def char_type(length: int) -> CharType:
 
 @dataclass(frozen=True)
 class ArrayType(Type):
-    """ARRAY(T). Host-represented for now (no device storage)."""
+    """ARRAY(T). Pooled representation: device storage is int32 codes
+    into a host dictionary whose values are python tuples — the string
+    strategy generalized to composites (SURVEY §7 'varchar on TPU'),
+    so grouping/joins/sorting on arrays run on codes/ranks."""
 
     element: Type = UNKNOWN
 
+    @property
+    def is_array(self) -> bool:
+        return True
+
 
 def array_type(element: Type) -> ArrayType:
-    return ArrayType(name=f"array({element})", storage=None, element=element,
-                     orderable=False)
+    return ArrayType(name=f"array({element})",
+                     storage=np.dtype(np.int32), element=element)
 
 
 @dataclass(frozen=True)
@@ -266,6 +283,8 @@ def parse_type(text: str) -> Type:
         return _SIMPLE_TYPES[t]
     if t.endswith(" with time zone") and t.startswith("timestamp"):
         return TIMESTAMP_TZ
+    if t.startswith("array(") and t.endswith(")"):
+        return array_type(parse_type(t[len("array("):-1]))
     m = _PARAM_RE.match(t)
     if m:
         base, p1, p2 = m.group(1), int(m.group(2)), m.group(3)
