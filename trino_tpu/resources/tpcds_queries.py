@@ -8,6 +8,85 @@ INTERVAL (the engine's dialect, as in the reference's own runs).
 """
 
 TPCDS_QUERIES = {
+    # q3: brand revenue by year for one manufacturer in November
+    3: """
+select d_year, i_brand_id, i_brand, sum(ss_ext_sales_price) as sum_agg
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manufact_id = 53
+  and d_moy = 11
+group by d_year, i_brand_id, i_brand
+order by d_year, sum_agg desc, i_brand_id
+limit 100
+""",
+    # q7: average sale metrics per item for one demographic slice
+    7: """
+select i_item_id,
+       avg(ss_quantity) as agg1, avg(ss_list_price) as agg2,
+       avg(ss_coupon_amt) as agg3, avg(ss_sales_price) as agg4
+from store_sales, customer_demographics, date_dim, item, promotion
+where ss_sold_date_sk = d_date_sk
+  and ss_item_sk = i_item_sk
+  and ss_cdemo_sk = cd_demo_sk
+  and ss_promo_sk = p_promo_sk
+  and cd_gender = 'M'
+  and cd_marital_status = 'S'
+  and cd_education_status = 'College'
+  and (p_channel_email = 'N' or p_channel_event = 'N')
+  and d_year = 2000
+group by i_item_id
+order by i_item_id
+limit 100
+""",
+    # q19: brand revenue where customer and store zip prefixes differ
+    19: """
+select i_brand_id as brand_id, i_brand as brand,
+       i_manufact_id, i_manufact,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item, customer, customer_address, store
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 8
+  and d_moy = 11
+  and d_year = 1999
+  and ss_customer_sk = c_customer_sk
+  and c_current_addr_sk = ca_address_sk
+  and substring(ca_zip from 1 for 5) <> substring(s_zip from 1 for 5)
+  and ss_store_sk = s_store_sk
+group by i_brand_id, i_brand, i_manufact_id, i_manufact
+order by ext_price desc, i_brand, i_brand_id, i_manufact_id,
+         i_manufact
+limit 100
+""",
+    # q42: category revenue for one manager's items in November
+    42: """
+select d_year, i_category_id, i_category,
+       sum(ss_ext_sales_price) as revenue
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 1
+  and d_moy = 11
+  and d_year = 2000
+group by d_year, i_category_id, i_category
+order by revenue desc, d_year, i_category_id, i_category
+limit 100
+""",
+    # q55: brand revenue for one manager in one month
+    55: """
+select i_brand_id as brand_id, i_brand as brand,
+       sum(ss_ext_sales_price) as ext_price
+from date_dim, store_sales, item
+where d_date_sk = ss_sold_date_sk
+  and ss_item_sk = i_item_sk
+  and i_manager_id = 28
+  and d_moy = 11
+  and d_year = 1999
+group by i_brand_id, i_brand
+order by ext_price desc, i_brand_id
+limit 100
+""",
     # q64: cross-channel sales of the same item by the same store in
     # consecutive years (the "cross_sales" self-joined CTE)
     64: """
