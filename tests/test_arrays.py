@@ -123,3 +123,48 @@ def test_derived_string_grouping_regression(runner):
                count(*) over (partition by substr(n_name, 1, 1)) n
         from nation order by n desc, c limit 2""")
     assert rows == [("I", 4), ("A", 2)]
+
+
+def test_map_type(runner):
+    assert q(runner, "select map(array['a','b'], array[1,2])") == \
+        [({"a": 1, "b": 2},)]
+    assert q(runner, "select map(array['a','b'], array[1,2])['b'], "
+                     "map(array['a'], array[1])['z'], "
+                     "cardinality(map(array['a','b'], array[1,2]))") == \
+        [(2, None, 2)]
+    # construction order does not matter: maps normalize to sorted pairs
+    assert q(runner, "select map(array['b','a'], array[2,1]) = "
+                     "map(array['a','b'], array[1,2])") == [(True,)]
+    assert q(runner, "select map_keys(map(array['b','a'], array[2,1])), "
+                     "map_values(map(array['b','a'], array[2,1]))") == \
+        [(["a", "b"], [1, 2])]
+    assert q(runner, "select element_at(map(array[10,20], "
+                     "array['x','y']), 20)") == [("y",)]
+
+
+def test_map_validation_and_ordering(runner):
+    import pytest as _pytest
+
+    from trino_tpu.types import TrinoError
+
+    with _pytest.raises(TrinoError, match="same length"):
+        q(runner, "select map(array['a','b'], array[1])")
+    with _pytest.raises(TrinoError, match="Duplicate map keys"):
+        q(runner, "select map(array['a','a'], array[1,2])")
+    with _pytest.raises(TrinoError, match="cannot be null"):
+        q(runner, "select map(array['a', null], array[1,2])")
+    with _pytest.raises(TrinoError, match="not orderable"):
+        q(runner, "select map(array['a'], array[1]) < "
+                  "map(array['a'], array[2])")
+    with _pytest.raises(TrinoError, match="does not match"):
+        q(runner, "select map(array['a'], array[1])[123]")
+
+
+def test_map_wire_serde():
+    from trino_tpu.block import Block, Page
+    from trino_tpu.exec.serde import PageDeserializer, PageSerializer
+
+    t = T.map_type(T.VARCHAR, T.INTEGER)
+    page = Page([Block.from_pylist(t, [{"a": 1, "b": 2}, None])], 2)
+    out = PageDeserializer().deserialize(PageSerializer().serialize(page))
+    assert out.to_rows() == [({"a": 1, "b": 2},), (None,)]

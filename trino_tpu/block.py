@@ -188,6 +188,9 @@ class Block:
             if t.is_array:
                 # user-visible arrays are lists (pool entries are tuples)
                 raw = [None if v is None else list(v) for v in raw]
+            elif t.is_map:
+                # pool entries are sorted (key, value) pair tuples
+                raw = [None if v is None else dict(v) for v in raw]
         elif t.is_decimal:
             raw = [t.from_raw(v) for v in data.tolist()]
         elif t.is_timestamp_tz:
@@ -225,8 +228,14 @@ class Block:
         has_nulls = bool(nulls.any())
         if type_.is_pooled:
             d = dictionary if dictionary is not None else Dictionary()
+            if type_.is_map:
+                values = [v if v is None else
+                          tuple(sorted(v.items())
+                                if isinstance(v, dict) else v)
+                          for v in values]
             data = d.encode(values,
-                            null_value=() if type_.is_array else "")
+                            null_value=() if (type_.is_array
+                                              or type_.is_map) else "")
             return Block(type_, data, nulls if has_nulls else None, d)
         data = np.empty(n, dtype=type_.storage)
         if type_.is_timestamp_tz:

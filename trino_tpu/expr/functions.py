@@ -343,6 +343,13 @@ def _resolve_cmp(args):
     raise TypeError_(f"cannot compare {a} and {b}")
 
 
+def _resolve_cmp_ordering(args):
+    for a in args:
+        if not a.orderable:
+            raise TypeError_(f"type {a} is not orderable")
+    return _resolve_cmp(args)
+
+
 def _cmp_kernel(op):
     def kernel(raws, arg_types, ret_type):
         a, b = raws
@@ -362,10 +369,11 @@ def _cmp_kernel(op):
     return kernel
 
 
-for _n, _op in [("eq", jnp.equal), ("ne", jnp.not_equal), ("lt", jnp.less),
-                ("le", jnp.less_equal), ("gt", jnp.greater),
-                ("ge", jnp.greater_equal)]:
+for _n, _op in [("eq", jnp.equal), ("ne", jnp.not_equal)]:
     register(ScalarFunction(_n, _resolve_cmp, _cmp_kernel(_op)))
+for _n, _op in [("lt", jnp.less), ("le", jnp.less_equal),
+                ("gt", jnp.greater), ("ge", jnp.greater_equal)]:
+    register(ScalarFunction(_n, _resolve_cmp_ordering, _cmp_kernel(_op)))
 
 
 # ---------------------------------------------------------------------------
@@ -1037,8 +1045,9 @@ register(ScalarFunction("$ts_diff", _resolve_ts_diff, _ts_diff_kernel))
 
 
 def _resolve_cardinality(args):
-    if not args[0].is_array:
-        raise TypeError_(f"cardinality expects array, got {args[0]}")
+    if not (args[0].is_array or args[0].is_map):
+        raise TypeError_(
+            f"cardinality expects array or map, got {args[0]}")
     return T.BIGINT
 
 
@@ -1053,7 +1062,7 @@ def _element_of(a, i):
 
 def _resolve_element_at(args):
     if not args[0].is_array:
-        raise TypeError_(f"element_at expects array, got {args[0]}")
+        raise TypeError_(f"element_at expects array or map, got {args[0]}")
     if not _is_int(args[1]):
         raise TypeError_("element_at index must be an integer")
     return args[0].element
@@ -1118,3 +1127,63 @@ register(ScalarFunction(
                              default=None),
     str_transform=lambda a: max((v for v in a if v is not None),
                                 default=None)))
+
+
+# maps (pooled: sorted (key, value) pair tuples)
+
+
+def _resolve_map_ctor(args):
+    if len(args) != 2 or not (args[0].is_array and args[1].is_array):
+        raise TypeError_("map expects (array, array)")
+    return T.map_type(args[0].element, args[1].element)
+
+
+def _map_ctor(ks, vs):
+    from ..types import TrinoError
+
+    if len(ks) != len(vs):
+        raise TrinoError("Key and value arrays must be the same length",
+                         "INVALID_FUNCTION_ARGUMENT")
+    if any(k is None for k in ks):
+        raise TrinoError("map key cannot be null",
+                         "INVALID_FUNCTION_ARGUMENT")
+    if len(set(ks)) != len(ks):
+        raise TrinoError("Duplicate map keys are not allowed",
+                         "INVALID_FUNCTION_ARGUMENT")
+    return tuple(sorted(zip(ks, vs)))
+
+
+register(ScalarFunction("map", _resolve_map_ctor,
+                        str_transform=_map_ctor))
+
+
+def _resolve_map_get(args):
+    if not args[0].is_map:
+        raise TypeError_(f"expected map, got {args[0]}")
+    return args[0].value
+
+
+def _map_get(m, k):
+    return dict(m).get(k)
+
+
+register(ScalarFunction("$map_get", _resolve_map_get,
+                        str_scalar=_map_get, str_transform=_map_get))
+
+
+def _resolve_map_keys(args):
+    if not args[0].is_map:
+        raise TypeError_(f"expected map, got {args[0]}")
+    return T.array_type(args[0].key)
+
+
+def _resolve_map_values(args):
+    if not args[0].is_map:
+        raise TypeError_(f"expected map, got {args[0]}")
+    return T.array_type(args[0].value)
+
+
+register(ScalarFunction("map_keys", _resolve_map_keys,
+                        str_transform=lambda m: tuple(k for k, _ in m)))
+register(ScalarFunction("map_values", _resolve_map_values,
+                        str_transform=lambda m: tuple(v for _, v in m)))

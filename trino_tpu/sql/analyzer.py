@@ -315,6 +315,11 @@ class ExpressionAnalyzer:
         left = self.analyze(e.left)
         right = self.analyze(e.right)
         fn = _COMPARISON_FN[e.op]
+        if fn in ("lt", "le", "gt", "ge"):
+            for side in (left, right):
+                if not side.type.orderable:
+                    raise AnalysisError(
+                        f"type {side.type} is not orderable")
         if left.type != right.type:
             ct = common_type(left.type, right.type, e.op)
             left, right = coerce(left, ct), coerce(right, ct)
@@ -450,12 +455,20 @@ class ExpressionAnalyzer:
     def _an_Subscript(self, e):
         base = self.analyze(e.base)
         idx = self.analyze(e.index)
-        if not base.type.is_array:
-            raise AnalysisError(
-                f"subscript requires an array, got {base.type}")
         if not isinstance(idx, Literal):
-            raise AnalysisError("array subscript must be a literal")
-        return Call(base.type.element, "$subscript", (base, idx))
+            raise AnalysisError("subscript index must be a literal")
+        if base.type.is_array:
+            return Call(base.type.element, "$subscript", (base, idx))
+        if base.type.is_map:
+            # deviation from the reference: missing keys yield NULL
+            # (element_at semantics) instead of an error
+            if T.common_super_type(idx.type, base.type.key) is None:
+                raise AnalysisError(
+                    f"map key type {base.type.key} does not match "
+                    f"subscript type {idx.type}")
+            return Call(base.type.value, "$map_get", (base, idx))
+        raise AnalysisError(
+            f"subscript requires an array or map, got {base.type}")
 
     def _an_AtTimeZone(self, e):
         from ..expr import tz as _tz
@@ -540,6 +553,16 @@ class ExpressionAnalyzer:
                 ast.NullIfExpression(e.args[0], e.args[1]))
         if name in ("date_add", "date_diff", "date_trunc"):
             return self._date_fn(name, e)
+        if name == "element_at" and len(e.args) == 2:
+            base = self.analyze(e.args[0])
+            idx = self.analyze(e.args[1])
+            if base.type.is_map:
+                # map lookup routes to the key-typed host LUT, not the
+                # 1-based array subscript
+                return Call(base.type.value, "$map_get", (base, idx))
+            fn = F.get_function(name)
+            return Call(fn.resolve([base.type, idx.type]), name,
+                        (base, idx))
         args = [self.analyze(a) for a in e.args]
         fn = F.get_function(name)
         rt = fn.resolve([a.type for a in args])

@@ -67,10 +67,14 @@ class Type:
         return False
 
     @property
+    def is_map(self) -> bool:
+        return False
+
+    @property
     def is_pooled(self) -> bool:
         """Device storage is int32 codes into a host-side value pool
-        (strings and arrays); kernels see only the codes."""
-        return self.is_string or self.is_array
+        (strings, arrays, maps); kernels see only the codes."""
+        return self.is_string or self.is_array or self.is_map
 
     def zero(self):
         """Neutral raw storage value used for padding lanes."""
@@ -238,13 +242,23 @@ def row_type(fields_: list) -> RowType:
 
 @dataclass(frozen=True)
 class MapType(Type):
+    """MAP(K, V). Pooled like arrays: device codes into a host pool of
+    sorted (key, value) pair tuples — equal maps share one pool entry
+    regardless of construction order."""
+
     key: Type = UNKNOWN
     value: Type = UNKNOWN
 
+    @property
+    def is_map(self) -> bool:
+        return True
+
 
 def map_type(key: Type, value: Type) -> MapType:
-    return MapType(name=f"map({key}, {value})", storage=None, key=key,
-                   value=value, comparable=True, orderable=False)
+    # comparable (equality) but NOT orderable, as in the reference
+    return MapType(name=f"map({key}, {value})",
+                   storage=np.dtype(np.int32), key=key, value=value,
+                   orderable=False)
 
 
 # ---------------------------------------------------------------------------
@@ -285,6 +299,17 @@ def parse_type(text: str) -> Type:
         return TIMESTAMP_TZ
     if t.startswith("array(") and t.endswith(")"):
         return array_type(parse_type(t[len("array("):-1]))
+    if t.startswith("map(") and t.endswith(")"):
+        inner = t[len("map("):-1]
+        depth = 0
+        for i, ch in enumerate(inner):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                return map_type(parse_type(inner[:i]),
+                                parse_type(inner[i + 1:]))
     m = _PARAM_RE.match(t)
     if m:
         base, p1, p2 = m.group(1), int(m.group(2)), m.group(3)
