@@ -168,3 +168,42 @@ def test_map_wire_serde():
     page = Page([Block.from_pylist(t, [{"a": 1, "b": 2}, None])], 2)
     out = PageDeserializer().deserialize(PageSerializer().serialize(page))
     assert out.to_rows() == [({"a": 1, "b": 2},), (None,)]
+
+
+def test_row_type(runner):
+    assert q(runner, "select (1, 'a')") == [((1, "a"),)]
+    assert q(runner, "select (1, 'a')[2], (1, 'a')[1]") == [("a", 1)]
+    assert q(runner, "select (1, 'a') = (1, 'a'), "
+                     "(1, 'a') = (1, 'b')") == [(True, False)]
+
+
+def test_row_wire_serde():
+    from trino_tpu.block import Block, Page
+    from trino_tpu.exec.serde import PageDeserializer, PageSerializer
+
+    t = T.row_type([(None, T.BIGINT), (None, T.VARCHAR)])
+    page = Page([Block.from_pylist(t, [(1, "a"), None])], 2)
+    out = PageDeserializer().deserialize(PageSerializer().serialize(page))
+    rows = out.to_rows()
+    assert rows[0] == ((1, "a"),) and rows[1] == (None,)
+
+
+def test_map_null_values_and_case(runner):
+    # NULL map VALUES are legal and rank-comparable
+    assert q(runner, "select map(array['a','b'], array[1, null]) = "
+                     "map(array['a','b'], array[1, 2])") == [(False,)]
+    rows = q(runner, """
+        select m, count(*) from (
+            select case when n_nationkey = 1
+                        then map(array['a'], array[1]) end m
+            from nation) group by m order by 2""")
+    assert rows == [({"a": 1}, 1), (None, 24)]
+    import pytest as _pytest
+
+    from trino_tpu.types import TrinoError
+
+    with _pytest.raises(TrinoError, match="does not match"):
+        q(runner, "select element_at(map(array['a'], array[1]), 123)")
+    with _pytest.raises(TrinoError, match="not orderable"):
+        q(runner, "select m from (select map(array['a'], array[1]) m) "
+                  "order by m")

@@ -32,6 +32,22 @@ def padded_size(n: int, minimum: int = 16) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def _rank_sort_key(v):
+    """Total-order key over pool entries: None sorts first at every
+    nesting level, so nullable composite pools rank without TypeError."""
+    if v is None:
+        return (0, 0)
+    if isinstance(v, tuple):
+        return (1, tuple(_rank_sort_key(x) for x in v))
+    return (1, v)
+
+
+def null_pool_value(t) -> object:
+    """The type-homogeneous pool placeholder for NULL lanes."""
+    return () if (t.is_array or t.is_map
+                  or getattr(t, "is_row", False)) else ""
+
+
 class Dictionary:
     """Host-side string pool. Identity (``id()``) defines code compatibility:
     two blocks share code semantics iff they share the Dictionary object.
@@ -118,12 +134,31 @@ class Dictionary:
         comparisons/grouping over ranks match string equality. Lets ORDER
         BY / GROUP BY on strings run on device via rank[codes]."""
         if self._sort_rank is None or len(self._sort_rank) != len(self.values):
-            # np.asarray on equal-length tuples builds a 2-D array;
-            # assigning into an empty object array keeps entries intact
-            arr = np.empty(len(self.values), dtype=object)
-            arr[:] = self.values
-            _, inverse = np.unique(arr, return_inverse=True)
-            self._sort_rank = inverse.astype(np.int32)
+            vals = list(self.values)
+            if any(v is None or isinstance(v, tuple) for v in vals):
+                # composite/nullable pools: python comparisons between
+                # None and values (or nested Nones inside tuples) have
+                # no order — rank through a None-totalizing key
+                order = sorted(range(len(vals)),
+                               key=lambda i: _rank_sort_key(vals[i]))
+                ranks = np.empty(len(vals), dtype=np.int32)
+                r = -1
+                prev = object()
+                for i in order:
+                    k = _rank_sort_key(vals[i])
+                    if k != prev:
+                        r += 1
+                        prev = k
+                    ranks[i] = r
+                self._sort_rank = ranks
+            else:
+                # np.asarray on equal-length tuples builds a 2-D array;
+                # assigning into an empty object array keeps entries
+                # intact
+                arr = np.empty(len(vals), dtype=object)
+                arr[:] = vals
+                _, inverse = np.unique(arr, return_inverse=True)
+                self._sort_rank = inverse.astype(np.int32)
         return self._sort_rank
 
 
@@ -233,9 +268,7 @@ class Block:
                           tuple(sorted(v.items())
                                 if isinstance(v, dict) else v)
                           for v in values]
-            data = d.encode(values,
-                            null_value=() if (type_.is_array
-                                              or type_.is_map) else "")
+            data = d.encode(values, null_value=null_pool_value(type_))
             return Block(type_, data, nulls if has_nulls else None, d)
         data = np.empty(n, dtype=type_.storage)
         if type_.is_timestamp_tz:

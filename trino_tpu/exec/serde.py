@@ -102,7 +102,7 @@ class PageSerializer:
                 # concurrently (Dictionary.code is thread-safe growth),
                 # and len(values) re-read here could exceed the slice
                 self._sent_pools[(ch, -pool_id)] = sent_len + len(delta)
-                if b.type.is_array or b.type.is_map:
+                if b.type.is_pooled and not b.type.is_string:
                     # composite pool entries (tuples) ship as JSON;
                     # flag bit 4 tells the reader to decode them back
                     enc = [json.dumps(_jsonable(v)).encode()

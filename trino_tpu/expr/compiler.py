@@ -213,9 +213,14 @@ class PageProcessor:
         va, vb = self._str_view(a), self._str_view(b)
 
         def fill_pair(dicts):
+            from ..block import _rank_sort_key
+
             xs = va.values(dicts)
             ys = vb.values(dicts)
-            merged = sorted(set(v for v in xs + ys if v is not None))
+            # None-totalizing key: composite pool entries may hold
+            # nested NULLs that plain comparison cannot order
+            merged = sorted(set(v for v in xs + ys if v is not None),
+                            key=_rank_sort_key)
             rank = {v: i for i, v in enumerate(merged)}
             ra = np.asarray([rank.get(v, -1) for v in xs], dtype=np.int32)
             rb = np.asarray([rank.get(v, -1) for v in ys], dtype=np.int32)
@@ -633,7 +638,9 @@ class PageProcessor:
 
         self._out_dict_resolvers[id(e)] = merged_dict
 
-        null_pool_value = () if getattr(e.type, "is_array", False) else ""
+        from ..block import null_pool_value as _npv
+
+        null_pool_value = _npv(e.type)
 
         def code_slot(view: _StrView) -> int:
             if view.channel is None:
@@ -851,9 +858,10 @@ class PageProcessor:
                     key = (j, id(base), len(base))
                     d = self._dict_cache.get(key)
                     if d is None:
+                        from ..block import null_pool_value as _npv_fn
+
                         vals = view.values(dicts)
-                        npv = () if getattr(proj.type, "is_array",
-                                            False) else ""
+                        npv = _npv_fn(proj.type)
                         # pool must stay code-aligned with the input pool
                         # (derived values may repeat), so no dedup here
                         d = Dictionary.aligned(

@@ -343,13 +343,6 @@ def _resolve_cmp(args):
     raise TypeError_(f"cannot compare {a} and {b}")
 
 
-def _resolve_cmp_ordering(args):
-    for a in args:
-        if not a.orderable:
-            raise TypeError_(f"type {a} is not orderable")
-    return _resolve_cmp(args)
-
-
 def _cmp_kernel(op):
     def kernel(raws, arg_types, ret_type):
         a, b = raws
@@ -369,11 +362,12 @@ def _cmp_kernel(op):
     return kernel
 
 
-for _n, _op in [("eq", jnp.equal), ("ne", jnp.not_equal)]:
+# orderability of lt/le/gt/ge is enforced once, at analysis
+# (_an_ComparisonExpression / sort planning), not per-resolver
+for _n, _op in [("eq", jnp.equal), ("ne", jnp.not_equal), ("lt", jnp.less),
+                ("le", jnp.less_equal), ("gt", jnp.greater),
+                ("ge", jnp.greater_equal)]:
     register(ScalarFunction(_n, _resolve_cmp, _cmp_kernel(_op)))
-for _n, _op in [("lt", jnp.less), ("le", jnp.less_equal),
-                ("gt", jnp.greater), ("ge", jnp.greater_equal)]:
-    register(ScalarFunction(_n, _resolve_cmp_ordering, _cmp_kernel(_op)))
 
 
 # ---------------------------------------------------------------------------
@@ -1062,7 +1056,7 @@ def _element_of(a, i):
 
 def _resolve_element_at(args):
     if not args[0].is_array:
-        raise TypeError_(f"element_at expects array or map, got {args[0]}")
+        raise TypeError_(f"element_at expects array, got {args[0]}")
     if not _is_int(args[1]):
         raise TypeError_("element_at index must be an integer")
     return args[0].element

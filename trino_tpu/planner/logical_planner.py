@@ -1379,6 +1379,9 @@ class QueryPlanner:
                 else:
                     raise AnalysisError(
                         f"ORDER BY key not in output: {si.key!r}")
+            if not sym.type.orderable:
+                raise AnalysisError(
+                    f"type {sym.type} is not orderable")
             orderings.append(Ordering(sym, si.ascending, si.nulls_last))
         node = rp.node
         if limit is not None and offset == 0:

@@ -368,6 +368,8 @@ class ExpressionAnalyzer:
 
     def _an_BetweenPredicate(self, e):
         v = self.analyze(e.value)
+        if not v.type.orderable:
+            raise AnalysisError(f"type {v.type} is not orderable")
         lo = self.analyze(e.min)
         hi = self.analyze(e.max)
         ct = v.type
@@ -434,6 +436,20 @@ class ExpressionAnalyzer:
         utc = wall_to_utc_host(days * 86_400_000_000, zone)
         return Literal(T.timestamp_tz_type(zone), utc)
 
+    def _an_Row(self, e):
+        """ROW literal -> pooled tuple (elements must fold to literals,
+        like arrays)."""
+        elems = [self.analyze(x) for x in e.items]
+        vals = []
+        for el in elems:
+            if not isinstance(el, Literal):
+                raise AnalysisError(
+                    "ROW elements must be literals (per-row construction "
+                    "is not supported)")
+            vals.append(el.value)
+        rt = T.row_type([(None, el.type) for el in elems])
+        return Literal(rt, tuple(vals))
+
     def _an_ArrayConstructor(self, e):
         """ARRAY literal -> pooled value (a python tuple in the code
         pool). Elements must fold to literals: per-row array
@@ -459,6 +475,13 @@ class ExpressionAnalyzer:
             raise AnalysisError("subscript index must be a literal")
         if base.type.is_array:
             return Call(base.type.element, "$subscript", (base, idx))
+        if getattr(base.type, "is_row", False):
+            if not isinstance(idx.value, int) or not (
+                    1 <= idx.value <= len(base.type.types)):
+                raise AnalysisError(
+                    f"row field index {idx.value} out of range")
+            return Call(base.type.types[idx.value - 1], "$subscript",
+                        (base, idx))
         if base.type.is_map:
             # deviation from the reference: missing keys yield NULL
             # (element_at semantics) instead of an error
@@ -559,6 +582,10 @@ class ExpressionAnalyzer:
             if base.type.is_map:
                 # map lookup routes to the key-typed host LUT, not the
                 # 1-based array subscript
+                if T.common_super_type(idx.type, base.type.key) is None:
+                    raise AnalysisError(
+                        f"map key type {base.type.key} does not match "
+                        f"element_at key type {idx.type}")
                 return Call(base.type.value, "$map_get", (base, idx))
             fn = F.get_function(name)
             return Call(fn.resolve([base.type, idx.type]), name,

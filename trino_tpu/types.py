@@ -71,10 +71,15 @@ class Type:
         return False
 
     @property
+    def is_row(self) -> bool:
+        return False
+
+    @property
     def is_pooled(self) -> bool:
         """Device storage is int32 codes into a host-side value pool
-        (strings, arrays, maps); kernels see only the codes."""
-        return self.is_string or self.is_array or self.is_map
+        (strings, arrays, maps, rows); kernels see only the codes."""
+        return self.is_string or self.is_array or self.is_map \
+            or self.is_row
 
     def zero(self):
         """Neutral raw storage value used for padding lanes."""
@@ -228,16 +233,24 @@ def array_type(element: Type) -> ArrayType:
 
 @dataclass(frozen=True)
 class RowType(Type):
+    """ROW(T1, T2, ...). Pooled like arrays: pool entries are python
+    tuples; field access is a LUT gather."""
+
     names: tuple = ()
     types: tuple = ()
+
+    @property
+    def is_row(self) -> bool:
+        return True
 
 
 def row_type(fields_: list) -> RowType:
     names = tuple(n for n, _ in fields_)
     types = tuple(t for _, t in fields_)
-    name = "row(" + ", ".join(f"{n} {t}" for n, t in fields_) + ")"
-    return RowType(name=name, storage=None, names=names, types=types,
-                   orderable=False)
+    name = "row(" + ", ".join(
+        (f"{n} {t}" if n else str(t)) for n, t in fields_) + ")"
+    return RowType(name=name, storage=np.dtype(np.int32), names=names,
+                   types=types, orderable=False)
 
 
 @dataclass(frozen=True)
@@ -299,6 +312,19 @@ def parse_type(text: str) -> Type:
         return TIMESTAMP_TZ
     if t.startswith("array(") and t.endswith(")"):
         return array_type(parse_type(t[len("array("):-1]))
+    if t.startswith("row(") and t.endswith(")"):
+        inner = t[len("row("):-1]
+        parts, depth, start = [], 0, 0
+        for i, ch in enumerate(inner):
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            elif ch == "," and depth == 0:
+                parts.append(inner[start:i])
+                start = i + 1
+        parts.append(inner[start:])
+        return row_type([(None, parse_type(p)) for p in parts])
     if t.startswith("map(") and t.endswith(")"):
         inner = t[len("map("):-1]
         depth = 0
