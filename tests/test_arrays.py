@@ -207,3 +207,76 @@ def test_map_null_values_and_case(runner):
     with _pytest.raises(TrinoError, match="not orderable"):
         q(runner, "select m from (select map(array['a'], array[1]) m) "
                   "order by m")
+
+
+def test_array_join_keys_remap_regression(runner):
+    """Equi-join on ARRAY keys must remap probe-pool codes into the
+    build pool (round-3 advisor: is_pooled, not is_string, gates the
+    canonicalize/remap path — raw cross-pool code equality is wrong)."""
+    plain = q(runner, """
+        select count(*) from
+          (select substr(n_name, 1, 1) a from nation) x join
+          (select substr(n_name, 1, 1) r from nation
+           where n_nationkey >= 10) y
+          on x.a = y.r""")
+    arr = q(runner, """
+        select count(*) from
+          (select split(substr(n_name, 1, 1), '|') a from nation) x join
+          (select split(substr(n_name, 1, 1), '|') r from nation
+           where n_nationkey >= 10) y
+          on x.a = y.r""")
+    assert arr == plain and plain[0][0] > 0
+    # semi-join path (IN over arrays)
+    plain = q(runner, """
+        select count(*) from nation where substr(n_name, 1, 1) in
+          (select substr(n_name, 1, 1) from nation
+           where n_nationkey < 3)""")
+    arr = q(runner, """
+        select count(*) from nation
+        where split(substr(n_name, 1, 1), '|') in
+          (select split(substr(n_name, 1, 1), '|') from nation
+           where n_nationkey < 3)""")
+    assert arr == plain and plain[0][0] > 0
+
+
+def test_array_order_by_value_order(runner):
+    """ORDER BY an ARRAY column sorts by VALUE rank, not pool
+    insertion order (round-3 advisor: value_u64 must rank pooled
+    types)."""
+    arr = q(runner, """
+        select n_nationkey from nation
+        order by split(n_name, ' '), n_nationkey""")
+    plain = q(runner, """
+        select n_nationkey from nation
+        order by n_name, n_nationkey""")
+    assert arr == plain
+
+
+def test_array_min_max_aggregates(runner):
+    """min/max over ARRAY args reduce on value ranks, mapping back to
+    codes (round-3 advisor: pooled, not just string, args)."""
+    lo, hi = q(runner, "select min(n_name), max(n_name) from nation")[0]
+    rows = q(runner, "select min(split(n_name, ' ')), "
+                     "max(split(n_name, ' ')) from nation")
+    assert rows == [(lo.split(' '), hi.split(' '))]
+
+
+def test_window_min_max_pooled_args(runner):
+    """Window min/max over string/array args rank-reduce per frame
+    (round-3 advisor: the window kernel reduced raw pool codes)."""
+    per_group = dict((r[0], r[1]) for r in q(runner, """
+        select n_regionkey, min(n_name) from nation group by 1"""))
+    rows = q(runner, """
+        select n_regionkey, min(n_name) over (partition by n_regionkey)
+        from nation""")
+    for g, v in rows:
+        assert v == per_group[g]
+    arr_group = dict((r[0], r[1]) for r in q(runner, """
+        select n_regionkey, max(split(n_name, ' ')) from nation
+        group by 1"""))
+    rows = q(runner, """
+        select n_regionkey,
+               max(split(n_name, ' ')) over (partition by n_regionkey)
+        from nation""")
+    for g, v in rows:
+        assert v == arr_group[g]

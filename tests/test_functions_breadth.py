@@ -207,3 +207,18 @@ def test_delete_via_plan_quoted_identifiers():
         'delete from "weird col" where "select" = \'a\'').rows == [(1,)]
     assert r.execute('select count(*) from "weird col"').rows == [(2,)]
     assert r.execute('delete from "weird col"').rows == [(2,)]
+
+
+def test_extract_time_of_day_fields(runner):
+    assert one(runner, "select extract(hour from timestamp "
+                       "'2020-06-01 13:45:30.250'), "
+                       "minute(timestamp '2020-06-01 13:45:30.250'), "
+                       "second(timestamp '2020-06-01 13:45:30.250'), "
+                       "millisecond(timestamp "
+                       "'2020-06-01 13:45:30.250')") == (13, 45, 30, 250)
+    # tz values read the wall clock in their zone; DATE fields are 0
+    assert one(runner, "select extract(hour from timestamp "
+                       "'2020-06-01 23:10:00 +02:30'), "
+                       "extract(minute from timestamp "
+                       "'2020-06-01 23:10:00 +02:30'), "
+                       "hour(date '2020-06-01')") == (23, 10, 0)

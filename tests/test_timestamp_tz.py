@@ -134,3 +134,47 @@ def test_create_table_with_tz_column(runner):
     rows = r.execute("select id, at from events").rows
     assert rows[0][0] == 1
     assert rows[0][1].utcoffset() is not None
+
+
+def test_ambiguous_wall_time_resolves_to_earlier_offset(runner):
+    """Fall-back overlap: 01:30 on 2025-11-02 in New York exists at
+    both EDT (-4) and EST (-5); the reference (Joda convertLocalToUTC)
+    picks the EARLIER offset — EDT — so the instant is 05:30 UTC."""
+    (v,) = one(runner, "select timestamp "
+                       "'2025-11-02 01:30:00 America/New_York' "
+                       "AT TIME ZONE 'UTC'")
+    assert (v.hour, v.minute) == (5, 30)
+    # spring-forward gap: 02:30 never happens; carried across the gap
+    # with the pre-transition offset (EST) -> 07:30 UTC
+    (v,) = one(runner, "select timestamp "
+                       "'2025-03-09 02:30:00 America/New_York' "
+                       "AT TIME ZONE 'UTC'")
+    assert (v.hour, v.minute) == (7, 30)
+
+
+def test_tzif_footer_extends_past_table():
+    """TZif v2+ footer TZ string must keep DST alternation alive past
+    the last tabulated transition (~2037 for fat tzdata)."""
+    jul = int(datetime.datetime(
+        2050, 7, 1, tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+    jan = int(datetime.datetime(
+        2050, 1, 15, tzinfo=datetime.timezone.utc).timestamp() * 1e6)
+    assert tz.offset_at("America/New_York", jul) == -4 * 3600 * 1_000_000
+    assert tz.offset_at("America/New_York", jan) == -5 * 3600 * 1_000_000
+    # southern hemisphere: DST in January
+    assert tz.offset_at("Australia/Sydney", jan) == 11 * 3600 * 1_000_000
+    assert tz.offset_at("Australia/Sydney", jul) == 10 * 3600 * 1_000_000
+
+
+def test_unixtime_session_zone():
+    """from_unixtime renders in the session zone; to_unixtime reads a
+    plain TIMESTAMP's wall clock in the session zone (reference:
+    DateTimeFunctions.java)."""
+    r = LocalQueryRunner({"tpch": TpchConnector(page_rows=256)},
+                         Session(catalog="tpch", schema="micro",
+                                 timezone="America/New_York"))
+    (v,) = one(r, "select from_unixtime(1579082400)")  # 2020-01-15 10:00 UTC
+    assert (v.hour, v.utcoffset()) == (5, datetime.timedelta(hours=-5))
+    # wall 05:00 EST == 10:00 UTC == 1579082400
+    (u,) = one(r, "select to_unixtime(timestamp '2020-01-15 05:00:00')")
+    assert u == 1579082400.0

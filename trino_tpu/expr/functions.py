@@ -582,8 +582,29 @@ def _to_days(raw, t):
     return raw
 
 
+def _wall_micros(raw, t):
+    """Wall-clock micros-of-day for time-of-day fields (0 for DATE)."""
+    if t.is_timestamp_tz:
+        from .tz import device_utc_to_wall
+
+        wall = device_utc_to_wall(raw, t.zone)
+        return jnp.remainder(wall, np.int64(86_400_000_000))
+    if t == T.TIMESTAMP:
+        return jnp.remainder(raw, np.int64(86_400_000_000))
+    return jnp.zeros_like(raw, dtype=jnp.int64)
+
+
 def _date_part_kernel(part):
     def kernel(raws, arg_types, ret_type):
+        if part in ("hour", "minute", "second", "millisecond"):
+            us = _wall_micros(raws[0], arg_types[0])
+            if part == "hour":
+                return us // np.int64(3_600_000_000)
+            if part == "minute":
+                return (us // np.int64(60_000_000)) % 60
+            if part == "second":
+                return (us // np.int64(1_000_000)) % 60
+            return (us // np.int64(1_000)) % 1000
         days = _to_days(raws[0], arg_types[0])
         y, m, d = _civil_from_days(days)
         if part == "year":
@@ -611,9 +632,11 @@ def _date_part_kernel(part):
 
 
 for _p in ["year", "month", "day", "quarter", "day_of_week", "day_of_year",
-           "week"]:
+           "week", "hour", "minute", "second", "millisecond"]:
     register(ScalarFunction(f"$extract_{_p}", _resolve_date_part,
                             _date_part_kernel(_p)))
+for _n in ("hour", "minute", "second", "millisecond"):
+    register(ScalarFunction(_n, _resolve_date_part, _date_part_kernel(_n)))
 register(ScalarFunction("year", _resolve_date_part, _date_part_kernel("year")))
 register(ScalarFunction("month", _resolve_date_part, _date_part_kernel("month")))
 register(ScalarFunction("day", _resolve_date_part, _date_part_kernel("day")))

@@ -73,7 +73,8 @@ def _parse_tzif(path: str) -> Tuple[np.ndarray, np.ndarray]:
     isutcnt, isstdcnt, leapcnt, timecnt, typecnt, charcnt = counts
     v1_len = 44 + timecnt * 5 + typecnt * 6 + charcnt + leapcnt * 8 \
         + isstdcnt + isutcnt
-    if version >= b"2":
+    has_footer = version >= b"2"
+    if has_footer:
         # second, 64-bit block follows the v1 block
         off = v1_len
         version, counts = header(off)
@@ -101,7 +102,101 @@ def _parse_tzif(path: str) -> Tuple[np.ndarray, np.ndarray]:
     out_offs = np.concatenate([[offsets[first]],
                                offsets[idx] if timecnt
                                else np.zeros(0, np.int64)])
+    if has_footer:
+        # v2+ footer: a POSIX TZ string giving the rule for instants
+        # past the last tabulated transition (RFC 8536 §3.3). Without
+        # it the last offset freezes (~2037 for fat tzdata).
+        parts = data.rsplit(b"\n", 2)
+        tzstr = parts[1].decode("ascii", "replace") if len(parts) == 3 \
+            else ""
+        ext = _footer_transitions(tzstr, out_trans, out_offs)
+        if ext is not None:
+            out_trans, out_offs = ext
     return out_trans, out_offs
+
+
+#: how far the footer rule is unrolled into explicit transitions
+_FOOTER_END_YEAR = 2100
+
+_TZNAME = r"(?:[A-Za-z]{3,}|<[A-Za-z0-9+-]+>)"
+_TZOFF = r"([+-]?\d{1,3}(?::\d{1,2}(?::\d{1,2})?)?)"
+_POSIX_TZ_RE = re.compile(
+    rf"^{_TZNAME}{_TZOFF}(?:({_TZNAME}){_TZOFF}?(?:,([^,]+),([^,]+))?)?$")
+
+
+def _hms_seconds(s: str) -> int:
+    sign = -1 if s.startswith("-") else 1
+    parts = s.lstrip("+-").split(":")
+    sec = 0
+    for unit, v in zip((3600, 60, 1), parts):
+        sec += unit * int(v)
+    return sign * sec
+
+
+def _rule_instant(rule: str, year: int) -> int:
+    """Local epoch-seconds (as if UTC) of one POSIX transition rule in
+    ``year``: Mm.w.d, Jn, or n, with optional /time (default 02:00;
+    extended range ±167h allowed)."""
+    import calendar
+    import datetime
+
+    time_s = 2 * 3600
+    if "/" in rule:
+        rule, t = rule.split("/", 1)
+        time_s = _hms_seconds(t)
+    if rule.startswith("M"):
+        m, w, d = (int(x) for x in rule[1:].split("."))
+        first_wd = (datetime.date(year, m, 1).weekday() + 1) % 7  # Sun=0
+        day = 1 + (d - first_wd) % 7 + (w - 1) * 7
+        while day > calendar.monthrange(year, m)[1]:
+            day -= 7
+        date = datetime.date(year, m, day)
+    elif rule.startswith("J"):
+        n = int(rule[1:])  # 1..365, Feb 29 never counted
+        date = datetime.date(year, 1, 1) + datetime.timedelta(n - 1)
+        if calendar.isleap(year) and n >= 60:
+            date += datetime.timedelta(1)
+    else:
+        n = int(rule)      # 0..365, Feb 29 counted
+        date = datetime.date(year, 1, 1) + datetime.timedelta(n)
+    epoch_day = (date - datetime.date(1970, 1, 1)).days
+    return epoch_day * 86400 + time_s
+
+
+def _footer_transitions(tzstr: str, trans: np.ndarray,
+                        offs: np.ndarray):
+    """Extend (trans, offs) with transitions synthesized from the footer
+    POSIX TZ string through ``_FOOTER_END_YEAR``, or None if the string
+    is absent/unsupported/DST-free (the frozen last offset is then
+    already correct for a constant-offset tail)."""
+    import datetime
+
+    m = _POSIX_TZ_RE.match(tzstr.strip())
+    if m is None:
+        return None
+    std_s, dst_name, dst_s, start_rule, end_rule = m.groups()
+    if not dst_name or not start_rule:
+        return None  # no DST tail: constant offset, nothing to extend
+    std_off = -_hms_seconds(std_s)          # POSIX: positive = west
+    dst_off = (-_hms_seconds(dst_s)) if dst_s else std_off + 3600
+    last = int(trans[-1]) if len(trans) > 1 else 0
+    y0 = datetime.datetime.fromtimestamp(
+        max(last, 0), datetime.timezone.utc).year
+    new = []
+    for year in range(y0, _FOOTER_END_YEAR + 1):
+        try:
+            to_dst = _rule_instant(start_rule, year) - std_off
+            to_std = _rule_instant(end_rule, year) - dst_off
+        except (ValueError, IndexError):
+            return None
+        new.extend([(to_dst, dst_off), (to_std, std_off)])
+    new = [(t, o) for (t, o) in sorted(new) if t > last]
+    if not new:
+        return None
+    return (np.concatenate([trans, np.array([t for t, _ in new],
+                                            dtype=np.int64)]),
+            np.concatenate([offs, np.array([o for _, o in new],
+                                           dtype=np.int64)]))
 
 
 @lru_cache(maxsize=64)
@@ -123,14 +218,18 @@ def utc_offset_table(zone: str) -> Tuple[np.ndarray, np.ndarray]:
 
 @lru_cache(maxsize=64)
 def wall_offset_table(zone: str) -> Tuple[np.ndarray, np.ndarray]:
-    """Like utc_offset_table but keyed by *wall* time: entry i applies to
-    wall instants ``>= trans_utc[i] + offset[i]``. Ambiguous wall times
-    around backward transitions resolve to the later (post-transition)
-    offset; gapped wall times resolve forward — the conventional
-    single-valued inverse."""
+    """Like utc_offset_table but keyed by *wall* time. Entry i applies to
+    wall instants ``>= trans_utc[i] + max(offset[i], offset[i-1])``:
+    ambiguous wall times in a fall-back overlap stay in entry i-1 (the
+    EARLIER, pre-transition offset — the reference's Joda
+    ``convertLocalToUTC`` pick), and nonexistent spring-forward gap
+    times also resolve with the pre-transition offset (clock carried
+    forward across the gap)."""
     trans, offs = utc_offset_table(zone)
-    wall = np.where(trans == _NEG_INF, _NEG_INF, trans + offs)
-    # enforce monotonicity (backward transitions make wall go back)
+    prev = np.concatenate([offs[:1], offs[:-1]])
+    wall = np.where(trans == _NEG_INF, _NEG_INF,
+                    trans + np.maximum(offs, prev))
+    # safety: keep starts monotone for searchsorted
     wall = np.maximum.accumulate(wall)
     return wall.astype(np.int64), offs
 

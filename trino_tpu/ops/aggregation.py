@@ -198,7 +198,7 @@ def _init_states(agg: AggCall, cols, nulls, valid, dicts=None) -> List:
         x = raw.astype(jnp.int64)
         return [jnp.where(live, x, 0), live.astype(jnp.int64)]
     if f in ("min", "max"):
-        if agg.arg_type is not None and agg.arg_type.is_string:
+        if agg.arg_type is not None and agg.arg_type.is_pooled:
             # reduce on lexicographic RANKS (codes are pool-order);
             # _map_rank_states restores codes after the reduce
             rank_lut, _ = _rank_and_inverse(
@@ -234,7 +234,7 @@ def _merge_states(agg: AggCall, state_cols, valid, state_dicts=None) -> List:
     arrive as codes and re-enter the reduce as lexicographic ranks."""
     plan = _state_plan(agg)
     count = state_cols[-1]  # every aggregate's last state is its count
-    is_str = agg.arg_type is not None and agg.arg_type.is_string
+    is_str = agg.arg_type is not None and agg.arg_type.is_pooled
     out = []
     for j, ((kind, _dt), s) in enumerate(zip(plan, state_cols)):
         if kind == "sum":
@@ -383,7 +383,7 @@ class HashAggregationOperator(Operator):
         # rank, carried across pages as a code in the arg's pool)
         self._str_state: List[bool] = []
         for a in self.aggregates:
-            is_str = a.arg_type is not None and a.arg_type.is_string
+            is_str = a.arg_type is not None and a.arg_type.is_pooled
             for (k, _) in _state_plan(a):
                 self._str_state.append(is_str and k in ("min", "max"))
         self._state_dicts: List = [None] * len(self._str_state)
@@ -547,7 +547,7 @@ class HashAggregationOperator(Operator):
         from ..block import Dictionary
 
         for i in range(nkeys):
-            if self._group_dicts[i] is None and types[i].is_string:
+            if self._group_dicts[i] is None and types[i].is_pooled:
                 self._group_dicts[i] = Dictionary()
         if self._ctx is not None:
             # once merging starts the partials stop being revocable; if

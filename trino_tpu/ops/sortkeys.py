@@ -50,7 +50,10 @@ def value_u64(raw, type_: T.Type, dictionary: Optional[Dictionary] = None):
     f64<->u64 bitcasts, so float keys stay float operands (lax.sort
     compares them natively); see sort_operands/group_operands.
     """
-    if type_.is_string:
+    if type_.is_pooled:
+        # strings AND pooled composites (array/map/row): codes are pool
+        # insertion order, so sort on the pool's value rank instead
+        # (Dictionary.sort_rank totalizes tuples/None)
         return _rank_lut(dictionary)[raw]
     if type_ == T.BOOLEAN:
         return raw.astype(jnp.uint64)

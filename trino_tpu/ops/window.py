@@ -409,21 +409,51 @@ class WindowOperator(Operator):
                 page.cols[k.channel], page.nulls[k.channel],
                 page.types[k.channel], page.dictionaries[k.channel],
                 ascending=k.ascending, nulls_last=k.nulls_last))
+        # pooled (string/array/map/row) min/max args reduce on value
+        # RANKS, not raw pool codes (insertion order): append a rank
+        # column per such call, retarget the call at it, and map the
+        # reduced rank back to a representative code after the kernel
+        import dataclasses
+
+        from .aggregation import _rank_and_inverse
+
+        calls = list(self.calls)
+        all_cols = list(page.cols)
+        all_nulls = list(page.nulls)
+        restore: dict = {}
+        for i, c in enumerate(calls):
+            if c.function in ("min", "max") and c.arg_type is not None \
+                    and c.arg_type.is_pooled:
+                d = page.dictionaries[c.arg_channel]
+                rank_lut, inv = _rank_and_inverse(d)
+                restore[i] = (inv, d)
+                calls[i] = dataclasses.replace(
+                    c, arg_channel=len(all_cols), arg_type=T.BIGINT)
+                all_cols.append(jnp.asarray(rank_lut)[
+                    page.cols[c.arg_channel]])
+                all_nulls.append(page.nulls[c.arg_channel])
+        nch = len(page.types)
         s_cols, s_nulls, s_valid, w_cols, w_nulls = _window_kernel(
-            tuple(part_ops), tuple(order_ops), tuple(page.cols),
-            tuple(page.nulls), page.valid,
+            tuple(part_ops), tuple(order_ops), tuple(all_cols),
+            tuple(all_nulls), page.valid,
             num_part_ops=len(part_ops), num_order_ops=len(order_ops),
-            calls=self.calls)
-        cols = list(s_cols) + [c.astype(t.storage) for c, t in
-                               zip(w_cols, [c.output_type
-                                            for c in self.calls])]
-        nulls = list(s_nulls) + list(w_nulls)
-        # value functions over string args keep the arg's code pool
+            calls=tuple(calls))
+        w_cols = list(w_cols)
+        for i, (inv, _d) in restore.items():
+            r = jnp.clip(w_cols[i], 0, len(inv) - 1)
+            w_cols[i] = jnp.asarray(inv)[r]
+        cols = list(s_cols[:nch]) + [c.astype(t.storage) for c, t in
+                                     zip(w_cols, [c.output_type
+                                                  for c in self.calls])]
+        nulls = list(s_nulls[:nch]) + list(w_nulls)
+        # value functions over pooled args keep the arg's code pool;
+        # rank-reduced min/max restores the captured pool
         dicts = list(page.dictionaries) + [
-            page.dictionaries[c.arg_channel]
-            if (c.output_type.is_string and c.arg_channel is not None)
-            else None
-            for c in self.calls]
+            restore[i][1] if i in restore
+            else (page.dictionaries[c.arg_channel]
+                  if (c.output_type.is_pooled and c.arg_channel is not None)
+                  else None)
+            for i, c in enumerate(self.calls)]
         return DevicePage(self.output_types, cols, nulls, s_valid, dicts)
 
     def is_finished(self) -> bool:

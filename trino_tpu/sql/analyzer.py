@@ -591,6 +591,19 @@ class ExpressionAnalyzer:
             return Call(fn.resolve([base.type, idx.type]), name,
                         (base, idx))
         args = [self.analyze(a) for a in e.args]
+        # session-zone semantics (reference: DateTimeFunctions.java —
+        # from_unixtime renders in the session zone; to_unixtime reads a
+        # plain TIMESTAMP's wall clock in the session zone)
+        if name in ("from_unixtime", "to_unixtime"):
+            zone = getattr(self.session, "timezone", "UTC") or "UTC"
+            if name == "from_unixtime":
+                fn = F.get_function(name)
+                fn.resolve([a.type for a in args])  # validate arg
+                return Call(T.timestamp_tz_type(zone), name, tuple(args))
+            if args and args[0].type == T.TIMESTAMP:
+                # wall micros -> UTC instant via the session zone's rules
+                args[0] = Call(T.timestamp_tz_type(zone), "$cast",
+                               (args[0],))
         fn = F.get_function(name)
         rt = fn.resolve([a.type for a in args])
         return Call(rt, name, tuple(args))
