@@ -21,6 +21,7 @@ def build_probe(build_types, build_cols, build_keys, probe_types,
     builder.get_output()
     probe = LookupJoinOperator(probe_types, probe_keys, bridge, join_type)
     probe.add_input(dev(probe_types, probe_cols))
+    probe.finish()
     out = probe.get_output()
     return out.to_page() if out is not None else None
 

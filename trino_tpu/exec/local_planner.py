@@ -252,9 +252,10 @@ class LocalExecutionPlanner:
                 pred)
             filter_fn = proc.process
 
-        pops.append(LookupJoinOperator(ptypes, probe_keys, bridge,
-                                       join_type, filter_fn,
-                                       max_lanes=self.join_max_lanes))
+        pops.append(LookupJoinOperator(
+            ptypes, probe_keys, bridge, join_type, filter_fn,
+            max_lanes=self.join_max_lanes,
+            memory_limited=self.memory_pool is not None))
         if join_type in ("semi", "anti"):
             out_layout = dict(playout)
             out_types = ptypes
@@ -456,8 +457,10 @@ class LocalExecutionPlanner:
             memory_context=self._mem_ctx("setop-build")))
         self.pipelines.append(PhysicalPipeline(bops))
         pchans = [playout[s.name] for s in left.output_symbols]
-        pops.append(LookupJoinOperator(ptypes, pchans, bridge, join_type,
-                                       max_lanes=self.join_max_lanes))
+        pops.append(LookupJoinOperator(
+            ptypes, pchans, bridge, join_type,
+            max_lanes=self.join_max_lanes,
+            memory_limited=self.memory_pool is not None))
         # distinct over the probe columns; output channels follow pchans
         # order, i.e. channel j <-> left.output_symbols[j] <-> symbols[j]
         pops.append(HashAggregationOperator(

@@ -11,10 +11,15 @@ columns normalize to uint64 (exact for single keys; packed or hashed for
 multi-key), ``lax.sort`` orders the build rows, and probing is two
 ``searchsorted`` calls (XLA-native vectorized binary search) giving each
 probe row its candidate range. Matches expand via cumsum offsets into a
-static-capacity output (host reads the exact total first — one scalar
-sync), and candidates are verified against the raw key columns, so hash
-collisions cost only capacity, never correctness. Unmatched-probe lanes
-for LEFT/ANTI come from a segment-OR over verified matches.
+static-capacity output whose size is GUESSED from a running expansion
+ratio (jit shapes are static, so some host value must pick the
+capacity); the exact total rides along as an unread device scalar and is
+checked only when the probe pipeline is already ``pipeline_depth`` pages
+deep — the host never blocks on the page it just enqueued, and an
+overflowing guess (rare) re-expands at the exact size. Candidates are
+verified against the raw key columns, so hash collisions cost only
+capacity, never correctness. Unmatched-probe lanes for LEFT/ANTI come
+from a segment-OR over verified matches.
 
 Two-operator split with a JoinBridge mirrors the reference; the physical
 planner runs the build pipeline to completion before the probe pipeline.
@@ -80,14 +85,6 @@ def _key_u64(cols, nulls, types_, mode: str) -> Tuple:
         hi, lo = ops[0], ops[1]
         return (hi << np.uint64(32)) | (lo & np.uint64(0xFFFFFFFF)), anynull
     return _hash_combine(ops), anynull
-
-
-def choose_key_mode(key_cols_u64_max_bits: int, num_keys: int) -> str:
-    if num_keys == 1:
-        return "single"
-    if num_keys == 2 and key_cols_u64_max_bits <= 32:
-        return "packed"
-    return "hashed"
 
 
 def _hash_combine(ops):
@@ -258,34 +255,43 @@ class HashBuilderOperator(Operator):
             cols = [jnp.zeros(cap, dtype=t.storage) for t in self.input_types]
             nulls = [jnp.ones(cap, dtype=bool) for _ in self.input_types]
             valid = jnp.zeros(cap, dtype=bool)
-            dicts = [Dictionary() if t.is_string else None
+            dicts = [Dictionary() if t.is_pooled else None
                      for t in self.input_types]
         for ch, df in self.dynamic_filters:
             df.collect(cols[ch], nulls[ch], valid)
         kc = self.key_channels
-        # string keys join on dictionary CODES in the build's pool: the
-        # build side uses its own codes as plain ints; the probe side
-        # remaps its codes into this pool (LookupJoinOperator._remap),
-        # so both sides feed _key_u64 the same integer key space.
+        # pooled keys (strings AND array/map/row composites) join on
+        # dictionary CODES in the build's pool: the build side uses its
+        # own codes as plain ints; the probe side remaps its codes into
+        # this pool (LookupJoinOperator._remap), so both sides feed
+        # _key_u64 the same integer key space.
         # CANONICALIZE build key codes first: aligned pools (derived by
-        # string transforms) may map one value to several codes, and
+        # transforms) may map one value to several codes, and
         # code-equality must mean value-equality for the join keys.
-        # Canonical codes decode to the same strings, so rewriting the
+        # Canonical codes decode to the same values, so rewriting the
         # stored column is output-safe.
         for c in kc:
-            if self.input_types[c].is_string:
+            if self.input_types[c].is_pooled:
                 cols[c] = _canonical_codes(cols[c], dicts[c])
-        key_types = [T.BIGINT if self.input_types[c].is_string
+        key_types = [T.BIGINT if self.input_types[c].is_pooled
                      else self.input_types[c] for c in kc]
         mode = "single" if len(kc) == 1 else "hashed"
         if len(kc) == 2:
-            # host decision (one sync at build publish): exact 32-bit pack?
-            bits = 0
-            for c, t in zip(kc, key_types):
-                ops = group_operands(cols[c], nulls[c], t)
-                mx = int(jnp.max(jnp.where(valid, ops[1], np.uint64(0))))
-                bits = max(bits, mx.bit_length())
-            mode = choose_key_mode(bits, 2)
+            # static decision — no device sync: pack two keys iff both
+            # are provably 32-bit lanes (4-byte integer/bool storage, or
+            # pooled codes, int32 by construction; sign-extension keeps
+            # the low 32 bits injective). Floats are excluded: their
+            # frexp encoding uses all 64 bits, so truncation would mass-
+            # collide. The u64 key is only a bucketing function —
+            # candidates are verified against raw keys — so a
+            # conservative choice is safe either way.
+            fits32 = [
+                self.input_types[c].is_pooled
+                or (t.storage is not None
+                    and np.dtype(t.storage).kind in "iub"
+                    and np.dtype(t.storage).itemsize <= 4)
+                for c, t in zip(kc, key_types)]
+            mode = "packed" if all(fits32) else "hashed"
         key, anynull = _key_u64([cols[c] for c in kc],
                                 [nulls[c] for c in kc], key_types, mode)
         ks, us, vs, scols, snulls = _build_sorted(
@@ -333,10 +339,19 @@ class LookupJoinOperator(Operator):
     #: _expand_matches blows HBM at scale)
     max_lanes = 1 << 20
 
+    #: probe pages whose guessed-capacity outputs are enqueued on device
+    #: but not yet overflow-checked. The oldest is checked — ONE scalar
+    #: read, computed pipeline_depth-1 pages ago and thus long since
+    #: done — only when the pipeline is full or upstream stalls, so the
+    #: host never blocks on kernels it just enqueued (round-3 verdict:
+    #: int(jnp.sum(count)) serialized host and device per probe page)
+    pipeline_depth = 4
+
     def __init__(self, probe_types: Sequence[T.Type],
                  probe_key_channels: Sequence[int], bridge: JoinBridge,
                  join_type: str = "inner",
-                 filter_fn=None, max_lanes: Optional[int] = None):
+                 filter_fn=None, max_lanes: Optional[int] = None,
+                 memory_limited: bool = False):
         assert join_type in ("inner", "left", "full", "semi", "anti")
         self.probe_types = list(probe_types)
         self.probe_keys = list(probe_key_channels)
@@ -345,8 +360,20 @@ class LookupJoinOperator(Operator):
         self.filter_fn = filter_fn  # optional post-join residual filter
         if max_lanes is not None:
             self.max_lanes = max_lanes
-        # prepared work units: (page, pkey_cols, pusable, lo, count, total)
-        self._work: List = []
+        if memory_limited:
+            # pool-governed query: the pending buffers are invisible to
+            # the memory manager's reserve/revoke machinery, so keep the
+            # pre-pipelining one-page-in-flight footprint
+            self.pipeline_depth = 1
+        self._pending: List[dict] = []   # awaiting overflow check
+        self._ready: List[DevicePage] = []
+        # EWMA lanes-per-probe-row for the capacity guess. Starts below
+        # 1 so the first guess lands in the page's own pow2 bucket (N:1
+        # joins then never overflow and never double the page); a
+        # fan-out join overflows once, the ratio learns, later pages
+        # guess right. pow2 padding gives the headroom.
+        self._ratio = 0.75
+        self._added_since_get = False
         self._done = False
         # FULL OUTER state: per-sorted-build-row matched flag (device,
         # cap+1 lanes — the last is the dead-lane sink) + the dictionary
@@ -355,7 +382,7 @@ class LookupJoinOperator(Operator):
         self._build_matched = None
         self._probe_dicts = None
         self._emitted_unmatched = False
-        # probe-dict -> build-dict code remap LUTs for string join keys
+        # probe-dict -> build-dict code remap LUTs for pooled join keys
         self._remap_cache: dict = {}
 
     @property
@@ -366,15 +393,51 @@ class LookupJoinOperator(Operator):
         return list(self.probe_types) + list(b.types)
 
     def needs_input(self) -> bool:
-        return not self._work and not self._finishing
+        return (not self._ready
+                and len(self._pending) < self.pipeline_depth
+                and not self._finishing)
 
     def add_input(self, page: DevicePage):
-        self._work.extend(self._prepare(page))
+        """Enqueue the whole probe chain for this page — counts,
+        guessed-capacity expansion, finalize — WITHOUT reading anything
+        back; the overflow check happens in get_output once the
+        pipeline is deep enough to have hidden this page's latency."""
+        b = self.bridge.build
+        assert b is not None, "probe started before build finished"
+        kc = self.probe_keys
+        pkey_cols, key_types = self._probe_key_cols(page, b)
+        pkey, panynull = _key_u64(pkey_cols,
+                                  [page.nulls[c] for c in kc],
+                                  key_types, b.key_mode)
+        pusable = page.valid & ~panynull if panynull is not None \
+            else page.valid
+        lo, count = _probe_counts(b.key_sorted, b.usable_sorted, pkey,
+                                  pusable)
+        rows = int(page.valid.shape[0])
+        cap = padded_size(max(16, int(rows * self._ratio * 1.1)))
+        while cap > self.max_lanes and cap > 16:
+            cap >>= 1  # budget is checked POST-padding, like every path
+        out, keep, bidx = self._make_out(page, pkey_cols, pusable, lo,
+                                         count, cap)
+        self._pending.append({
+            "page": page, "pkey_cols": pkey_cols, "pusable": pusable,
+            "lo": lo, "count": count, "rows": rows, "cap": cap,
+            "total": jnp.sum(count), "out": out, "keep": keep,
+            "bidx": bidx})
+        self._added_since_get = True
 
     def get_output(self):
-        if self._work:
-            return self._join_page(*self._work.pop(0))
-        if self._finishing:
+        if self._ready:
+            return self._ready.pop(0)
+        if self._pending and (self._finishing
+                              or len(self._pending) >= self.pipeline_depth
+                              or not self._added_since_get):
+            self._verify_oldest()
+            self._added_since_get = False
+            if self._ready:
+                return self._ready.pop(0)
+        self._added_since_get = False
+        if self._finishing and not self._pending:
             if self.join_type == "full" and not self._emitted_unmatched:
                 self._emitted_unmatched = True
                 return self._unmatched_build_page()
@@ -382,6 +445,76 @@ class LookupJoinOperator(Operator):
                 self.bridge.destroy()
             self._done = True
         return None
+
+    def _verify_oldest(self):
+        """Overflow-check the oldest pending page: the deferred scalar
+        read. Fits the guess (common) -> emit as-is; overflowed (rare)
+        -> re-expand at the now-known exact size, chunked under the
+        lane budget."""
+        rec = self._pending.pop(0)
+        tot = int(rec["total"])
+        self._ratio = 0.75 * self._ratio \
+            + 0.25 * (tot / max(rec["rows"], 1))
+        if tot <= rec["cap"]:
+            self._mark_full(rec["keep"], rec["bidx"],
+                            rec["page"].dictionaries)
+            self._ready.append(rec["out"])
+            return
+        for unit in self._chunk_units(rec, tot):
+            out, keep, bidx = self._make_out(*unit)
+            self._mark_full(keep, bidx, rec["page"].dictionaries)
+            self._ready.append(out)
+
+    def _chunk_units(self, rec: dict, total: int) -> List:
+        """(page, pkey_cols, pusable, lo, count, lane_cap) units whose
+        expansions fit the lane budget; greedy contiguous row chunks
+        from the per-row counts (host copy only on this over-budget
+        path). A single row exceeding the budget still becomes its own
+        unit: out_cap grows to its fan-out, which no slicing avoids."""
+        page, pkey_cols, pusable = rec["page"], rec["pkey_cols"], \
+            rec["pusable"]
+        lo, count = rec["lo"], rec["count"]
+        if padded_size(max(total, 16)) <= self.max_lanes:
+            return [(page, pkey_cols, pusable, lo, count,
+                     padded_size(max(total, 16)))]
+        counts = np.asarray(count)
+        units: List = []
+        n = counts.shape[0]
+        i = 0
+        while i < n:
+            j = i
+            run = 0
+            while j < n and (j == i or
+                             padded_size(max(run + int(counts[j]), 16))
+                             <= self.max_lanes):
+                run += int(counts[j])
+                j += 1
+            cap = padded_size(j - i)
+            sl = slice(i, j)
+            sub = DevicePage(page.types,
+                             [_pad_dev(c[sl], cap) for c in page.cols],
+                             [_pad_dev(x[sl], cap) for x in page.nulls],
+                             _pad_dev(page.valid[sl], cap),
+                             page.dictionaries)
+            units.append((sub, [_pad_dev(k[sl], cap) for k in pkey_cols],
+                          _pad_dev(pusable[sl], cap),
+                          _pad_dev(lo[sl], cap), _pad_dev(count[sl], cap),
+                          padded_size(max(run, 16))))
+            i = j
+        return units
+
+    def _mark_full(self, keep, build_idx, pdicts):
+        """FULL OUTER bookkeeping, applied only AFTER the overflow check
+        passed (a truncated expansion must not mark build rows)."""
+        if self.join_type != "full" or keep is None:
+            return
+        b = self.bridge.build
+        bcap = int(b.valid_sorted.shape[0])
+        if self._build_matched is None:
+            self._build_matched = jnp.zeros(bcap + 1, dtype=bool)
+        self._build_matched = _mark_build_matched(
+            self._build_matched, keep, build_idx)
+        self._probe_dicts = pdicts
 
     def _unmatched_build_page(self) -> DevicePage:
         """FULL OUTER tail: build rows no kept lane ever matched, with
@@ -396,7 +529,7 @@ class LookupJoinOperator(Operator):
         pnulls = [jnp.ones(cap, dtype=bool) for _ in self.probe_types]
         pdicts = self._probe_dicts
         if pdicts is None:
-            pdicts = [Dictionary() if t.is_string else None
+            pdicts = [Dictionary() if t.is_pooled else None
                       for t in self.probe_types]
         return DevicePage(self.output_types, pcols + list(b.cols),
                           pnulls + list(b.nulls), unmatched,
@@ -434,14 +567,14 @@ class LookupJoinOperator(Operator):
 
     def _probe_key_cols(self, page: DevicePage, b: "BuildSide"):
         """Per key channel: the probe column transformed into the build's
-        key space (identity for non-strings; canonical code remap for
-        string keys — also when pools are shared, since an aligned pool
+        key space (identity for unpooled types; canonical code remap for
+        pooled keys — also when pools are shared, since an aligned pool
         may hold duplicate values under distinct codes)."""
         out = []
         types_ = []
         for i, c in enumerate(self.probe_keys):
             t = self.probe_types[c]
-            if t.is_string:
+            if t.is_pooled:
                 pd = page.dictionaries[c]
                 bd = b.dictionaries[b.key_channels[i]]
                 out.append(self._remap(pd, bd)[page.cols[c]])
@@ -451,69 +584,21 @@ class LookupJoinOperator(Operator):
                 types_.append(t)
         return out, types_
 
-    def _prepare(self, page: DevicePage) -> List:
-        """Probe-count one page (keys + binary search computed ONCE) and
-        slice it into work units whose expansions fit max_lanes; each
-        unit joins lazily in get_output, one per driver quantum."""
-        b = self.bridge.build
-        assert b is not None, "probe started before build finished"
-        kc = self.probe_keys
-        pkey_cols, key_types = self._probe_key_cols(page, b)
-        pkey, panynull = _key_u64(pkey_cols,
-                                  [page.nulls[c] for c in kc],
-                                  key_types, b.key_mode)
-        pusable = page.valid & ~panynull if panynull is not None \
-            else page.valid
-        lo, count = _probe_counts(b.key_sorted, b.usable_sorted, pkey,
-                                  pusable)
-        # ONE SCALAR sync per probe page — total match count picks the
-        # static expansion capacity (out_cap is a jit static arg, so a
-        # host value is unavoidable); the full per-row count vector only
-        # crosses to host on the rare over-budget chunking path
-        total = int(jnp.sum(count))
-        if padded_size(max(total, 16)) <= self.max_lanes:
-            return [(page, pkey_cols, pusable, lo, count, total)]
-        counts = np.asarray(count)
-        # greedy contiguous row chunks under the lane budget (a single
-        # row exceeding it still becomes its own unit: out_cap grows to
-        # its fan-out, which no slicing can avoid)
-        units: List = []
-        n = counts.shape[0]
-        i = 0
-        while i < n:
-            j = i
-            run = 0
-            while j < n and (j == i or
-                             padded_size(max(run + int(counts[j]), 16))
-                             <= self.max_lanes):
-                run += int(counts[j])
-                j += 1
-            cap = padded_size(j - i)
-            sl = slice(i, j)
-            sub = DevicePage(page.types,
-                             [_pad_dev(c[sl], cap) for c in page.cols],
-                             [_pad_dev(x[sl], cap) for x in page.nulls],
-                             _pad_dev(page.valid[sl], cap),
-                             page.dictionaries)
-            units.append((sub, [_pad_dev(k[sl], cap) for k in pkey_cols],
-                          _pad_dev(pusable[sl], cap),
-                          _pad_dev(lo[sl], cap), _pad_dev(count[sl], cap),
-                          run))
-            i = j
-        return units
-
-    def _join_page(self, page: DevicePage, pkey_cols, pusable, lo, count,
-                   total: int) -> DevicePage:
+    def _make_out(self, page: DevicePage, pkey_cols, pusable, lo, count,
+                  lane_cap: int) -> Tuple:
+        """One expansion at static capacity ``lane_cap``: returns
+        (out_page, keep, build_idx). keep/build_idx feed the FULL OUTER
+        marker — applied by the caller only after the overflow check —
+        and are None for semi/anti (no build channels in the output)."""
         b = self.bridge.build
 
         if self.join_type in ("semi", "anti"):
-            cap = padded_size(max(total, 16))
             if self.filter_fn is None:
                 matched = _semi_matched(
                     lo, count,
                     tuple(pkey_cols),
                     tuple(b.cols[c] for c in b.key_channels),
-                    page.valid.shape[0], out_cap=cap)
+                    page.valid.shape[0], out_cap=lane_cap)
             else:
                 # residual-filtered semi/anti (q21's l3.l_suppkey <>
                 # l1.l_suppkey): expand candidate lanes, verify keys,
@@ -522,7 +607,8 @@ class LookupJoinOperator(Operator):
                 probe_idx, build_idx, keep = _expand_verified(
                     lo, count,
                     tuple(pkey_cols),
-                    tuple(b.cols[c] for c in b.key_channels), out_cap=cap)
+                    tuple(b.cols[c] for c in b.key_channels),
+                    out_cap=lane_cap)
                 lanes = _gather_lanes(page, b, probe_idx, build_idx, keep)
                 matched = _segment_any(self.filter_fn(lanes).valid,
                                        probe_idx, page.valid.shape[0])
@@ -530,10 +616,9 @@ class LookupJoinOperator(Operator):
                 new_valid = page.valid & matched
             else:
                 new_valid = page.valid & ~matched
-            return DevicePage(page.types, page.cols, page.nulls, new_valid,
-                              page.dictionaries)
+            return (DevicePage(page.types, page.cols, page.nulls,
+                               new_valid, page.dictionaries), None, None)
 
-        lane_cap = padded_size(max(total, 16))
         probe_idx, build_idx, keep = _expand_verified(
             lo, count,
             tuple(pkey_cols),
@@ -543,13 +628,6 @@ class LookupJoinOperator(Operator):
             # failing it make the probe row unmatched, not dropped
             lanes = _gather_lanes(page, b, probe_idx, build_idx, keep)
             keep = self.filter_fn(lanes).valid
-        if self.join_type == "full":
-            bcap = int(b.valid_sorted.shape[0])
-            if self._build_matched is None:
-                self._build_matched = jnp.zeros(bcap + 1, dtype=bool)
-            self._build_matched = _mark_build_matched(
-                self._build_matched, keep, build_idx)
-            self._probe_dicts = page.dictionaries
         out_cols, out_nulls, out_valid = _finalize_join(
             tuple(page.cols), tuple(page.nulls), page.valid,
             tuple(b.cols), tuple(b.nulls),
@@ -557,8 +635,8 @@ class LookupJoinOperator(Operator):
             left=self.join_type in ("left", "full"))
         types = self.output_types
         dicts = list(page.dictionaries) + list(b.dictionaries)
-        return DevicePage(types, list(out_cols), list(out_nulls),
-                          out_valid, dicts)
+        return (DevicePage(types, list(out_cols), list(out_nulls),
+                           out_valid, dicts), keep, build_idx)
 
 
 @partial(jax.jit, static_argnames=("left",))
