@@ -1,12 +1,16 @@
-"""Benchmark entry: prints ONE JSON line
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""Benchmark entry: prints one JSON line PER METRIC
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N};
+the LAST line is the headline (q3 — the join+agg+TopN pipeline).
 
-Measures TPC-H q1 (scan data pre-generated; pipeline = host->device upload +
-fused filter/project + sort-based group aggregation) in lineitem rows/sec on
-the real TPU chip. vs_baseline = TPU rate / single-CPU rate of the IDENTICAL
-pipeline (cached per schema in the committed .bench_cpu_cache.json) — the
-"vs CPU at equal node count" framing of BASELINE.md. Reference harness analog:
-testing/trino-benchmark/.../HandTpchQuery1.java (rows/s via LocalQueryRunner).
+Measures TPC-H q1 (pre-generated pages; host->device upload + fused
+filter/project + sort-based group aggregation) and q3 (customer/orders
+builds, semi + inner sorted-index joins, aggregation, TopN) in lineitem
+rows/sec on the real TPU chip. vs_baseline = TPU rate / single-CPU rate
+of the IDENTICAL pipeline (cached per query:schema in the committed
+.bench_cpu_cache.json) — the "vs CPU at equal node count" framing of
+BASELINE.md. Reference harness analog:
+testing/trino-benchmark/.../HandTpchQuery1.java (rows/s via
+LocalQueryRunner).
 
 Hardening (rounds 1+2 produced no number: rc=1 backend crash, then rc=124
 hang *after* a successful probe):
@@ -43,9 +47,16 @@ CACHE_PATH = os.path.join(REPO, ".bench_cpu_cache.json")
 # ----------------------------------------------------------------- child ----
 
 def _measure_child():
-    """BENCH_ROLE=measure: pin platform, run q1, print 'RESULT {json}'."""
+    """BENCH_ROLE=measure: pin platform, run q1 then q3, printing one
+    'RESULT {json}' line per query (q1 first so a partial kill still
+    leaves a result)."""
     schema = os.environ.get("BENCH_SCHEMA", "tiny")
     platform = os.environ.get("BENCH_PLATFORM", "default")
+    queries = [q.strip()
+               for q in os.environ.get("BENCH_QUERIES", "q1,q3").split(",")]
+    unknown = [q for q in queries if q not in ("q1", "q3")]
+    if unknown:
+        raise SystemExit(f"unknown BENCH_QUERIES entries: {unknown}")
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           "/tmp/trino_tpu_jax_cache")
     t0 = time.time()
@@ -61,31 +72,45 @@ def _measure_child():
     sys.stderr.write(f"child[{platform}]: devices {devs} "
                      f"{time.time() - t0:.1f}s\n")
 
-    from trino_tpu.benchmarks import build_q1_driver, scan_q1_pages
+    from trino_tpu.benchmarks import (build_q1_driver, build_q3_drivers,
+                                      scan_q1_pages, scan_q3_pages)
     from trino_tpu.connectors.tpch import TpchConnector
 
     conn = TpchConnector(page_rows=1 << 16)
-    pages = scan_q1_pages(conn, schema, desired_splits=8)
-    total_rows = sum(p.num_rows for p in pages)
-    sys.stderr.write(f"child[{platform}]: {total_rows} rows generated "
-                     f"{time.time() - t0:.1f}s\n")
-
-    times = []
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
-    for i in range(repeats):
-        driver, sink = build_q1_driver(conn, schema, source_pages=list(pages))
-        r0 = time.perf_counter()
-        driver.run_to_completion()
-        times.append(time.perf_counter() - r0)
-        sys.stderr.write(f"child[{platform}]: run {i + 1}/{repeats} "
-                         f"{times[-1]:.3f}s\n")
-    # first run pays compilation; take the best of the rest
-    best = min(times[1:]) if len(times) > 1 else times[0]
-    print("RESULT " + json.dumps({
-        "schema": schema, "platform": platform,
-        "device": str(devs[0]), "rows": total_rows,
-        "secs": best, "rate": total_rows / best,
-    }), flush=True)
+    for query in queries:
+        if query == "q1":
+            pages = scan_q1_pages(conn, schema, desired_splits=8)
+            total_rows = sum(p.num_rows for p in pages)
+
+            def make_drivers():
+                return [build_q1_driver(conn, schema,
+                                        source_pages=list(pages))[0]]
+        else:
+            cust, orders, li = scan_q3_pages(conn, schema,
+                                             desired_splits=8)
+            total_rows = sum(p.num_rows for p in li)
+
+            def make_drivers():
+                return build_q3_drivers(cust, orders, li)[0]
+        sys.stderr.write(f"child[{platform}]: {query} {total_rows} rows "
+                         f"generated {time.time() - t0:.1f}s\n")
+        times = []
+        for i in range(repeats):
+            drivers = make_drivers()
+            r0 = time.perf_counter()
+            for d in drivers:
+                d.run_to_completion()
+            times.append(time.perf_counter() - r0)
+            sys.stderr.write(f"child[{platform}]: {query} run "
+                             f"{i + 1}/{repeats} {times[-1]:.3f}s\n")
+        # first run pays compilation; take the best of the rest
+        best = min(times[1:]) if len(times) > 1 else times[0]
+        print("RESULT " + json.dumps({
+            "query": query, "schema": schema, "platform": platform,
+            "device": str(devs[0]), "rows": total_rows,
+            "secs": best, "rate": total_rows / best,
+        }), flush=True)
 
 
 # ---------------------------------------------------------------- parent ----
@@ -110,14 +135,16 @@ def _spawn(platform: str):
         env=env, tag=f"bench-{platform}")
 
 
-def _parse_result(text: str):
+def _parse_results(text: str):
+    """All RESULT lines, in print order (q1 before q3)."""
+    out = []
     for line in text.splitlines():
         if line.startswith("RESULT "):
             try:
-                return json.loads(line[len("RESULT "):])
+                out.append(json.loads(line[len("RESULT "):]))
             except ValueError:
                 continue
-    return None
+    return out
 
 
 def _load_cache():
@@ -127,14 +154,27 @@ def _load_cache():
         return {}
 
 
+def _base_for(cache, res):
+    """CPU-baseline rate for a result: 'q3:tiny' keys, with the bare
+    'tiny' spelling accepted for q1 (pre-round-4 cache layout)."""
+    q = res.get("query", "q1")
+    base = cache.get(f"{q}:{res['schema']}")
+    if base is None and q == "q1":
+        base = cache.get(res["schema"])
+    return base
+
+
 def _emit(state, res, suffix, base):
+    q = res.get("query", "q1")
     line = json.dumps({
-        "metric": f"tpch_q1_{res['schema']}_rows_per_sec{suffix}",
+        "metric": f"tpch_{q}_{res['schema']}_rows_per_sec{suffix}",
         "value": round(res["rate"], 1),
         "unit": "rows/s",
         "vs_baseline": round(res["rate"] / base, 3) if base else 0.0,
     })
     state["line"] = line
+    if q == "q3":
+        state["q3_line"] = line
     print(line, flush=True)
 
 
@@ -165,33 +205,30 @@ def main():
     threading.Thread(target=watchdog, daemon=True).start()
 
     cache = _load_cache()
-    base = cache.get(schema)
 
-    # Phase 1: CPU fallback child SOLO (~25 s). Its line goes out first so a
-    # parseable line exists on stdout early no matter when the driver's
-    # unknown outer timeout strikes.
+    # Phase 1: CPU fallback child SOLO (~60 s for q1+q3). Its lines go out
+    # first so a parseable line exists on stdout early no matter when the
+    # driver's unknown outer timeout strikes.
     cpu = _spawn("cpu")
     state["children"] = [cpu]
-    cpu_deadline = t_start + max(30.0, min(120.0, deadline - 60))
+    cpu_deadline = t_start + max(30.0, min(180.0, deadline - 60))
     while time.time() < cpu_deadline and not cpu.exited():
         time.sleep(0.5)
     cpu_text = cpu.kill()
-    cpu_res = _parse_result(cpu_text)
+    cpu_results = _parse_results(cpu_text)
     sys.stderr.write(f"bench: cpu child tail:\n{cpu_text[-800:]}\n")
-    cpu_printed = False
-    if cpu_res is not None:
-        cpu_printed = True
-        _emit(state, cpu_res, "_cpu_fallback", base)
-        if base is None:
-            # uncached schema: the phase-1 rate was measured solo, so it is
-            # a sound (if unpersisted) baseline for the ratio
-            base = cpu_res["rate"]
+    solo_base = {}
+    for res in cpu_results:
+        _emit(state, res, "_cpu_fallback", _base_for(cache, res))
+        # uncached query:schema: the phase-1 rate was measured solo, so
+        # it is a sound (if unpersisted) baseline for the ratio
+        solo_base[res.get("query", "q1")] = res["rate"]
 
     # Phase 2: TPU child SOLO — the per-chip rate must not be measured under
     # host CPU contention from the baseline child. One respawn on an early
     # crash (transient chip lock, the round-1 mode).
     tpu_deadline = t_start + max(60.0, min(tpu_budget, deadline - 30))
-    tpu_res = None
+    tpu_results = []
     tpu_text = ""
     for attempt in range(2):
         if time.time() >= tpu_deadline - 30:
@@ -202,29 +239,46 @@ def main():
             time.sleep(0.5)
         crashed_early = tpu.exited()
         tpu_text = tpu.kill()
-        # a killed child may still have written RESULT before hanging
-        tpu_res = _parse_result(tpu_text)
+        # a killed child may still have written RESULTs before hanging
+        tpu_results = _parse_results(tpu_text)
         sys.stderr.write(f"bench: tpu child (attempt {attempt + 1}) "
                          f"tail:\n{tpu_text[-1500:]}\n")
-        if tpu_res is not None or not crashed_early:
+        if tpu_results or not crashed_early:
             break  # success, or a hang (retrying a hang wastes the budget)
         time.sleep(5)
 
-    if tpu_res is not None:
-        is_tpu = "cpu" not in tpu_res["device"].lower()
-        # a CPU-fallback run must not masquerade as a per-chip TPU number;
-        # and if the default platform resolved to CPU, don't print a second
-        # (contention-free is moot — sequential now, but still duplicate)
-        # _cpu_fallback line when one is already out
+    for res in tpu_results:
+        q = res.get("query", "q1")
+        base = _base_for(cache, res) or solo_base.get(q)
+        is_tpu = "cpu" not in res["device"].lower()
+        # a CPU-fallback run must not masquerade as a per-chip TPU
+        # number; and if the default platform resolved to CPU, don't
+        # print a duplicate _cpu_fallback line when one is already out
         if is_tpu:
-            _emit(state, tpu_res, "_per_chip", base)
-        elif not cpu_printed:
-            _emit(state, tpu_res, "_cpu_fallback", base)
-    elif not cpu_printed and state["line"] is None:
-        print(json.dumps({
-            "metric": f"tpch_q1_{schema}_rows_per_sec_failed",
-            "value": 0.0, "unit": "rows/s", "vs_baseline": 0.0,
-        }), flush=True)
+            _emit(state, res, "_per_chip", base)
+        elif q not in solo_base:
+            _emit(state, res, "_cpu_fallback", base)
+    # any query with no emitted line at all gets an explicit failed
+    # line, so a child killed between its q1 and q3 prints cannot leave
+    # the q1 line masquerading as the headline (last-line) metric
+    emitted = {r.get("query", "q1") for r in cpu_results} | \
+        {r.get("query", "q1") for r in tpu_results}
+    printed_failed = False
+    for q in ("q1", "q3"):
+        if q not in emitted:
+            printed_failed = True
+            line = json.dumps({
+                "metric": f"tpch_{q}_{schema}_rows_per_sec_failed",
+                "value": 0.0, "unit": "rows/s", "vs_baseline": 0.0,
+            })
+            if state["line"] is None:
+                state["line"] = line
+            print(line, flush=True)
+    # a late q1 failed line must not displace a real q3 headline as the
+    # LAST stdout line — re-assert it
+    if printed_failed and state.get("q3_line"):
+        state["line"] = state["q3_line"]
+        print(state["q3_line"], flush=True)
 
 
 if __name__ == "__main__":

@@ -82,12 +82,26 @@ def build_q1_driver(conn: TpchConnector, schema: str = "tiny",
 
 def scan_q1_pages(conn: TpchConnector, schema: str = "tiny",
                   desired_splits: int = 4) -> List[Page]:
+    return scan_table_pages(conn, schema, "lineitem", Q1_COLUMNS,
+                            desired_splits)
+
+
+Q3_CUSTOMER = ["c_custkey", "c_mktsegment"]
+Q3_ORDERS = ["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]
+Q3_LINEITEM = ["l_orderkey", "l_extendedprice", "l_discount", "l_shipdate"]
+
+
+def scan_table_pages(conn: TpchConnector, schema: str, table: str,
+                     columns: Sequence[str],
+                     desired_splits: int = 4) -> List[Page]:
+    """Pre-generated host pages for a table (measurement isolates device
+    execution from data generation)."""
     meta = conn.metadata()
-    table = meta.get_table_handle(schema, "lineitem")
-    cols = {c.name: c for c in meta.get_columns(table)}
-    scan_cols = [cols[n] for n in Q1_COLUMNS]
-    pages = []
-    for s in conn.split_manager().get_splits(table, desired_splits):
+    th = meta.get_table_handle(schema, table)
+    cols = {c.name: c for c in meta.get_columns(th)}
+    scan_cols = [cols[n] for n in columns]
+    pages: List[Page] = []
+    for s in conn.split_manager().get_splits(th, desired_splits):
         src = conn.page_source(s, scan_cols)
         while True:
             p = src.get_next_page()
@@ -95,6 +109,90 @@ def scan_q1_pages(conn: TpchConnector, schema: str = "tiny",
                 break
             pages.append(p)
     return pages
+
+
+def scan_q3_pages(conn: TpchConnector, schema: str = "tiny",
+                  desired_splits: int = 4):
+    """(customer, orders, lineitem) page lists for build_q3_drivers."""
+    return tuple(
+        scan_table_pages(conn, schema, t, cols, desired_splits)
+        for t, cols in (("customer", Q3_CUSTOMER),
+                        ("orders", Q3_ORDERS),
+                        ("lineitem", Q3_LINEITEM)))
+
+
+def build_q3_drivers(cust_pages: Sequence[Page],
+                     ord_pages: Sequence[Page],
+                     li_pages: Sequence[Page]):
+    """TPC-H q3 as three hand-built pipelines — customer build, orders
+    semi-join + build, lineitem probe + aggregation + TopN — the
+    join-heavy companion to q1 (reference analog:
+    ``testing/trino-benchmark/.../HandTpchQuery6.java`` hand-building
+    operator chains around LocalQueryRunner). Returns
+    ([driver_a, driver_b, driver_c], sink); run the drivers in order."""
+    cutoff = days_from_civil_host(1995, 3, 15)
+    from .ops.join import HashBuilderOperator, JoinBridge, \
+        LookupJoinOperator
+    from .ops.operator import FilterProjectOperator
+    from .ops.sort import TopNOperator
+    from .ops.sortkeys import SortKey
+
+    # pipeline A: customer -> mktsegment filter -> build(custkey)
+    ctypes = [T.BIGINT, T.varchar_type(10)]
+    c_key = InputRef(ctypes[0], 0)
+    c_seg = InputRef(ctypes[1], 1)
+    c_filt = Call(T.BOOLEAN, "eq",
+                  (c_seg, Literal(ctypes[1], "BUILDING")))
+    proc_c = PageProcessor(ctypes, [c_key], c_filt)
+    b1 = JoinBridge()
+    da = Driver([ValuesOperator(list(cust_pages)),
+                 FilterProjectOperator(proc_c),
+                 HashBuilderOperator(proc_c.output_types, [0], b1)])
+
+    # pipeline B: orders -> date filter -> semi join vs customer ->
+    # trim to (orderkey, orderdate, shippriority) -> build(orderkey)
+    otypes = [T.BIGINT, T.BIGINT, T.DATE, T.BIGINT]
+    o_key, o_cust, o_date, o_prio = [
+        InputRef(t, i) for i, t in enumerate(otypes)]
+    o_filt = Call(T.BOOLEAN, "lt", (o_date, Literal(T.DATE, cutoff)))
+    proc_o = PageProcessor(otypes, [o_key, o_cust, o_date, o_prio],
+                           o_filt)
+    semi = LookupJoinOperator(proc_o.output_types, [1], b1, "semi")
+    trim_in = proc_o.output_types
+    proc_t = PageProcessor(trim_in, [InputRef(trim_in[0], 0),
+                                     InputRef(trim_in[2], 2),
+                                     InputRef(trim_in[3], 3)], None)
+    b2 = JoinBridge()
+    db = Driver([ValuesOperator(list(ord_pages)),
+                 FilterProjectOperator(proc_o), semi,
+                 FilterProjectOperator(proc_t),
+                 HashBuilderOperator(proc_t.output_types, [0], b2)])
+
+    # pipeline C: lineitem -> shipdate filter -> project revenue ->
+    # probe join -> group by (orderkey, orderdate, shippriority) ->
+    # TopN 10 by revenue desc, orderdate asc
+    ltypes = [T.BIGINT, D12_2, D12_2, T.DATE]
+    l_key, price, disc, ship = [
+        InputRef(t, i) for i, t in enumerate(ltypes)]
+    l_filt = Call(T.BOOLEAN, "gt", (ship, Literal(T.DATE, cutoff)))
+    one = Literal(T.BIGINT, 1)
+    rev_t = T.decimal_type(18, 4)
+    revenue = Call(rev_t, "multiply",
+                   (price, Call(T.decimal_type(13, 2), "subtract",
+                                (one, disc))))
+    proc_l = PageProcessor(ltypes, [l_key, revenue], l_filt)
+    probe = LookupJoinOperator(proc_l.output_types, [0], b2, "inner")
+    # probe output: probe channels + build channels
+    jtypes = list(proc_l.output_types) + list(proc_t.output_types)
+    aggs = [AggCall("sum", 1, rev_t, resolve_agg_type("sum", rev_t))]
+    agg = HashAggregationOperator(jtypes, [0, 3, 4], aggs)
+    topn = TopNOperator(agg.output_types,
+                        [SortKey(3, ascending=False),
+                         SortKey(1, ascending=True)], 10)
+    sink = OutputCollectorOperator()
+    dc = Driver([ValuesOperator(list(li_pages)),
+                 FilterProjectOperator(proc_l), probe, agg, topn, sink])
+    return [da, db, dc], sink
 
 
 def q1_device_step(input_types: List[T.Type]):
