@@ -1,0 +1,175 @@
+"""Streaming pipelined execution: all stages concurrent, pages flowing
+through exchanges with backpressure, blocked tasks parking on listen
+tokens.
+
+Reference analog: ``execution/scheduler/PipelinedQueryScheduler.java``
+(stage overlap), ``operator/Driver.java:380-486`` + ``Operator.java``
+isBlocked (blocked futures), ``execution/buffer/`` (bounded output
+buffers). Round-3 verdict #2: the engine previously barriered at every
+fragment boundary.
+"""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu import session_properties as SP
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.ops.output import ExchangeChannel, OutputBuffer
+from trino_tpu.parallel.distributed import DistributedQueryRunner
+from trino_tpu.resources.tpch_queries import TPCH_QUERIES
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+
+
+def make_dist(streaming: bool, **props):
+    sess = Session(catalog="tpch", schema="micro")
+    SP.set_property(sess.properties, "streaming_execution", streaming)
+    for k, v in props.items():
+        SP.set_property(sess.properties, k, v)
+    return DistributedQueryRunner({"tpch": TpchConnector(page_rows=512)},
+                                  sess, n_workers=4)
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=2048)},
+                            Session(catalog="tpch", schema="micro"))
+
+
+def test_streaming_q3_overlaps_and_matches(local):
+    """The verdict's done-criterion: distributed q3 where a consumer
+    stage dequeues pages BEFORE its producer stage finished (witnessed
+    by the buffer's first_poll/no_more timestamps), with results
+    identical to local execution."""
+    want = sorted(local.execute(TPCH_QUERIES[3]).rows)
+    res = make_dist(True).execute(TPCH_QUERIES[3])
+    assert sorted(res.rows) == want
+    overlap = res.stats["streaming_overlap"]
+    assert any(overlap.values()), (
+        f"no stage overlap observed: {overlap}")
+
+
+def test_streaming_matches_barrier_mode(local):
+    for q in (1, 10, 18):
+        want = sorted(make_dist(False).execute(TPCH_QUERIES[q]).rows)
+        got = sorted(make_dist(True).execute(TPCH_QUERIES[q]).rows)
+        assert got == want, f"q{q} streaming != barrier"
+
+
+def test_streaming_error_propagates_without_deadlock(local):
+    """A task dying mid-stream must fail the query (not deadlock
+    consumers parked on its buffer), and the runner stays usable."""
+    sess = Session(catalog="tpch", schema="micro")
+    SP.set_property(sess.properties, "streaming_execution", True)
+    conn = TpchConnector(page_rows=512)
+    orig = conn.page_source
+    state = {"calls": 0, "arm": True}
+
+    def failing_page_source(split, cols):
+        state["calls"] += 1
+        if state["arm"] and state["calls"] > 2:
+            raise RuntimeError("injected scan failure")
+        return orig(split, cols)
+
+    conn.page_source = failing_page_source
+    r = DistributedQueryRunner({"tpch": conn}, sess, n_workers=4)
+    with pytest.raises(RuntimeError, match="injected scan failure"):
+        r.execute(TPCH_QUERIES[3])
+    state["arm"] = False
+    # the runner is reusable after a failed query
+    assert r.execute("select count(*) from nation").rows == [(25,)]
+
+
+def test_bounded_buffer_backpressure_and_listen():
+    from trino_tpu.block import Page
+    from trino_tpu import types as T
+
+    buf = OutputBuffer(1, max_pending_pages=2)
+    page = Page.from_pylists([T.BIGINT], [[1, 2, 3]])
+    buf.enqueue(0, page)
+    buf.enqueue(0, page)
+    assert buf.full()
+    fired = []
+    buf.listen().on_ready(lambda: fired.append("space"))
+    assert not fired
+    chan = ExchangeChannel(buf, 0, 0)
+    assert chan.poll() is page     # drain one
+    assert fired == ["space"]      # producer listener woke
+    assert not buf.full()
+    # end-of-stream plumbing
+    assert not chan.at_end()
+    buf.set_no_more_pages()
+    assert chan.poll() is page
+    assert chan.poll() is None
+    assert chan.at_end()
+    assert buf.overlapped  # polled before no_more
+
+
+def test_listen_token_fires_immediately_when_stale():
+    from trino_tpu.block import Page
+    from trino_tpu import types as T
+
+    buf = OutputBuffer(1, max_pending_pages=8)
+    token = buf.listen()
+    buf.enqueue(0, Page.from_pylists([T.BIGINT], [[1]]))
+    fired = []
+    token.on_ready(lambda: fired.append(1))  # version moved: immediate
+    assert fired == [1]
+
+
+def test_task_executor_parks_blocked_entries():
+    """A Blocked yield parks the entry (no busy spin); the token wakeup
+    re-offers it exactly once."""
+    from trino_tpu.exec.task_executor import Blocked, TaskExecutor
+
+    ex = TaskExecutor(num_threads=2, name="test-exec")
+    buf = OutputBuffer(1, max_pending_pages=4)
+    from trino_tpu.block import Page
+    from trino_tpu import types as T
+
+    steps = []
+
+    def consumer():
+        chan = ExchangeChannel(buf, 0, 0)
+        while True:
+            p = chan.poll()
+            if p is not None:
+                steps.append("page")
+            elif chan.at_end():
+                steps.append("end")
+                return
+            else:
+                token = chan.listen()
+                if chan.at_end() or chan.has_page():
+                    continue
+                steps.append("park")
+                yield Blocked([token])
+
+    fut = ex.submit(consumer())
+    time.sleep(0.3)
+    assert steps == ["park"], f"consumer should park: {steps}"
+    buf.enqueue(0, Page.from_pylists([T.BIGINT], [[7]]))
+    time.sleep(0.3)
+    assert "page" in steps
+    buf.set_no_more_pages()
+    fut.result(timeout=10)
+    assert steps[-1] == "end"
+    ex.close()
+
+
+def test_abort_unblocks_producers_and_consumers():
+    from trino_tpu.block import Page
+    from trino_tpu import types as T
+
+    buf = OutputBuffer(1, max_pending_pages=1)
+    buf.enqueue(0, Page.from_pylists([T.BIGINT], [[1]]))
+    assert buf.full()
+    fired = []
+    buf.listen().on_ready(lambda: fired.append(1))
+    buf.abort()
+    assert fired == [1]
+    assert not buf.full()
+    chan = ExchangeChannel(buf, 0, 0)
+    assert chan.poll() is None and chan.at_end()

@@ -40,6 +40,9 @@ class Driver:
         assert operators, "empty pipeline"
         self.operators: List[Operator] = list(operators)
         self.collect_stats = collect_stats
+        #: whether the most recent process() quantum moved any page —
+        #: tasks only park on blocked tokens after a no-progress quantum
+        self.last_moved = False
         self.stats: List[OperatorStats] = [
             OperatorStats(type(op).__name__) for op in operators]
 
@@ -94,7 +97,20 @@ class Driver:
             # nothing moved: push finish from the head if it is done
             if ops[0].is_finished() and not ops[0]._finishing:
                 ops[0].finish()
+        self.last_moved = moved
         return ops[-1].is_finished()
+
+    def blocked_tokens(self) -> List:
+        """Listen tokens of currently-blocked operators. Meaningful
+        after a ``process()`` quantum that made no progress: the task
+        parks on these instead of spinning (reference:
+        Driver.java:380-486 blocked-future handling)."""
+        toks = []
+        for op in self.operators:
+            t = op.blocked_token()
+            if t is not None:
+                toks.append(t)
+        return toks
 
     def run_to_completion(self, max_quanta: int = 1_000_000):
         for _ in range(max_quanta):

@@ -93,6 +93,14 @@ class LocalExecutionPlanner:
             return None
         return self.memory_pool.create_context(name)
 
+    def _memory_constrained(self) -> bool:
+        """True when the query runs under active memory pressure
+        management (spill on): the join probe then keeps its
+        one-page-in-flight footprint, since its pending buffers are
+        invisible to the pool's reserve/revoke machinery."""
+        return self.memory_pool is not None \
+            and self.memory_pool.spill_enabled
+
     def plan(self, root: OutputNode) -> LocalExecutionPlan:
         ops, layout, types_ = self.visit(root.source)
         # final projection into output order
@@ -255,7 +263,7 @@ class LocalExecutionPlanner:
         pops.append(LookupJoinOperator(
             ptypes, probe_keys, bridge, join_type, filter_fn,
             max_lanes=self.join_max_lanes,
-            memory_limited=self.memory_pool is not None))
+            memory_limited=self._memory_constrained()))
         if join_type in ("semi", "anti"):
             out_layout = dict(playout)
             out_types = ptypes
@@ -460,7 +468,7 @@ class LocalExecutionPlanner:
         pops.append(LookupJoinOperator(
             ptypes, pchans, bridge, join_type,
             max_lanes=self.join_max_lanes,
-            memory_limited=self.memory_pool is not None))
+            memory_limited=self._memory_constrained()))
         # distinct over the probe columns; output channels follow pchans
         # order, i.e. channel j <-> left.output_symbols[j] <-> symbols[j]
         pops.append(HashAggregationOperator(

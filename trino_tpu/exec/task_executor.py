@@ -11,9 +11,15 @@ levels, so short queries keep low latency under concurrency.
 TPU adaptation: the schedulable unit is a GENERATOR — task code yields
 at page boundaries (one driver ``process()`` call per step), and the
 executor times each step to accumulate the entry's scheduled nanos.
-There is no blocked-future machinery: stage barriers mean exchange
-reads never wait mid-quantum (SURVEY §5: the stage boundary is the
-checkpoint), so a step always makes progress or finishes.
+
+Blocked-entry state (the streaming scheduler's requirement): a task
+that cannot progress yields ``Blocked(tokens)`` — listen tokens from
+its blocked operators (empty exchange channel, full output buffer) —
+and the entry PARKS instead of re-entering the queue: the first token
+to fire re-offers it (reference: ``operator/Driver.java:380-486``
+blocked futures + TaskExecutor's waiting splits). A reaper re-offers
+parked entries after a few seconds as a safety net, so a lost wakeup
+degrades to slow polling, never deadlock.
 """
 
 from __future__ import annotations
@@ -47,13 +53,27 @@ class TaskFuture:
             raise self._error
 
 
+class Blocked:
+    """Yield value signaling the task cannot progress; the executor
+    parks the entry until one of the tokens fires."""
+
+    __slots__ = ("tokens",)
+
+    def __init__(self, tokens):
+        self.tokens = list(tokens)
+
+
 class _Entry:
-    __slots__ = ("gen", "future", "scheduled_ns")
+    __slots__ = ("gen", "future", "scheduled_ns", "parked", "parked_at",
+                 "park_lock")
 
     def __init__(self, gen: Iterator):
         self.gen = gen
         self.future = TaskFuture()
         self.scheduled_ns = 0
+        self.parked = False
+        self.parked_at = 0.0
+        self.park_lock = threading.Lock()
 
     @property
     def level(self) -> int:
@@ -78,6 +98,8 @@ class MultilevelSplitQueue:
 
     def offer(self, entry: _Entry):
         with self._cond:
+            if self._closed:
+                return  # late wakeup after close: drop
             self._levels[entry.level].append(entry)
             self._cond.notify()
 
@@ -116,9 +138,16 @@ class MultilevelSplitQueue:
 class TaskExecutor:
     """Shared pool running task generators with per-step timing."""
 
+    #: reaper interval / max park time before a forced re-offer
+    reap_every_s = 1.0
+    max_park_s = 5.0
+
     def __init__(self, num_threads: Optional[int] = None,
                  name: str = "task-executor"):
         self.queue = MultilevelSplitQueue()
+        self._closed = False
+        self._parked: set = set()
+        self._parked_lock = threading.Lock()
         n = num_threads or max(1, min(8, os.cpu_count() or 1))
         self._threads = [
             threading.Thread(target=self._worker, daemon=True,
@@ -126,6 +155,9 @@ class TaskExecutor:
             for i in range(n)]
         for t in self._threads:
             t.start()
+        self._reaper = threading.Thread(target=self._reap, daemon=True,
+                                        name=f"{name}-reaper")
+        self._reaper.start()
 
     def submit(self, gen: Iterator) -> TaskFuture:
         entry = _Entry(gen)
@@ -146,6 +178,36 @@ class TaskExecutor:
         if errors:
             raise errors[0]
 
+    def _unpark(self, entry: _Entry):
+        """One-shot wakeup: the first firing token (or the reaper)
+        re-offers the entry; later firings are no-ops."""
+        with entry.park_lock:
+            if not entry.parked:
+                return
+            entry.parked = False
+        with self._parked_lock:
+            self._parked.discard(entry)
+        self.queue.offer(entry)
+
+    def _park(self, entry: _Entry, blocked: Blocked):
+        with entry.park_lock:
+            entry.parked = True
+            entry.parked_at = time.monotonic()
+        with self._parked_lock:
+            self._parked.add(entry)
+        for token in blocked.tokens:
+            token.on_ready(lambda e=entry: self._unpark(e))
+
+    def _reap(self):
+        while not self._closed:
+            time.sleep(self.reap_every_s)
+            now = time.monotonic()
+            with self._parked_lock:
+                stale = [e for e in self._parked
+                         if now - e.parked_at > self.max_park_s]
+            for e in stale:
+                self._unpark(e)
+
     def _worker(self):
         while True:
             entry = self.queue.take()
@@ -153,7 +215,7 @@ class TaskExecutor:
                 return
             t0 = time.perf_counter_ns()
             try:
-                next(entry.gen)
+                yielded = next(entry.gen)
             except StopIteration:
                 entry.scheduled_ns += time.perf_counter_ns() - t0
                 entry.future._finish()
@@ -162,10 +224,16 @@ class TaskExecutor:
                 entry.future._finish(e)
                 continue
             entry.scheduled_ns += time.perf_counter_ns() - t0
-            self.queue.offer(entry)
+            if isinstance(yielded, Blocked) and yielded.tokens:
+                self._park(entry, yielded)
+            else:
+                self.queue.offer(entry)
 
     def close(self):
+        self._closed = True
         self.queue.close()
+        with self._parked_lock:
+            self._parked.clear()
 
 
 _shared: Optional[TaskExecutor] = None

@@ -31,32 +31,236 @@ from ..parallel.exchange import (hash_partition_ids, key_to_u64,
 from .operator import Operator, SourceOperator
 
 
+class ListenToken:
+    """Snapshot of a buffer state version; ``on_ready(cb)`` fires cb
+    once when the state changes after the snapshot — immediately if it
+    already has (reference: the ListenableFuture returned by
+    Operator.isBlocked / OutputBuffer.isFull)."""
+
+    __slots__ = ("_buffer", "_version")
+
+    def __init__(self, buffer: "OutputBuffer", version: int):
+        self._buffer = buffer
+        self._version = version
+
+    def on_ready(self, cb: Callable[[], None]):
+        self._buffer._register(cb, self._version)
+
+
 class OutputBuffer:
     """Thread-safe per-partition page queues for one fragment's output
     (reference: execution/buffer/PartitionedOutputBuffer.java). With
-    ``broadcast=True`` every consumer reads all pages."""
+    ``broadcast=True`` every consumer reads all pages (per-consumer
+    cursors).
 
-    def __init__(self, num_partitions: int, broadcast: bool = False):
+    Two consumption modes share one producer API:
+    - barrier (``pages``): snapshot after the producing stage finished;
+    - streaming (``poll``/``at_end``/``listen``): pages are consumed as
+      producers enqueue them; ``set_no_more_pages`` marks the end;
+      ``full``/``listen`` on the producer side give backpressure
+      (reference: PipelinedQueryScheduler's streaming exchanges).
+    """
+
+    def __init__(self, num_partitions: int, broadcast: bool = False,
+                 max_pending_pages: Optional[int] = None):
         self.num_partitions = num_partitions
         self.broadcast = broadcast
+        #: producer backpressure: a partition holding this many
+        #: undrained pages reports full. None = unbounded — REQUIRED for
+        #: barrier-mode stages (the consumer stage hasn't started when
+        #: the producer runs, so any bound would deadlock); streaming
+        #: mode sets a bound. Broadcast buffers are always unbounded
+        #: (every consumer must see every page; build sides are small).
+        self.max_pending_pages = max_pending_pages
         self._lock = threading.Lock()
         self._pages: List[List[Page]] = [
             [] for _ in range(1 if broadcast else num_partitions)]
+        #: per-(partition,consumer) read cursors (broadcast keeps all
+        #: pages; partitioned consumers advance a drain cursor so the
+        #: barrier ``pages`` snapshot still sees everything)
+        self._cursors: Dict[tuple, int] = {}
+        self._no_more = False
+        self._aborted = False
+        self._version = 0
+        self._listeners: List[tuple] = []  # (cb, seen_version)
+        self._total_rows = 0
+        # streaming observability: did any consumer dequeue a page
+        # before the producers finished?
+        self.first_poll_ts: Optional[float] = None
+        self.no_more_ts: Optional[float] = None
+
+    # -- state/version plumbing -----------------------------------------
+
+    def _bump_locked(self) -> List[Callable]:
+        self._version += 1
+        fired = [cb for cb, _ in self._listeners]
+        self._listeners.clear()
+        return fired
+
+    def _register(self, cb: Callable[[], None], seen_version: int):
+        with self._lock:
+            if self._version == seen_version:
+                self._listeners.append((cb, seen_version))
+                return
+        cb()
+
+    def listen(self) -> ListenToken:
+        with self._lock:
+            return ListenToken(self, self._version)
+
+    # -- producer side ---------------------------------------------------
 
     def enqueue(self, partition: int, page: Page):
         if page.num_rows == 0:
             return
         with self._lock:
+            if self._aborted:
+                return
             self._pages[0 if self.broadcast else partition].append(page)
+            self._total_rows += page.num_rows
+            fired = self._bump_locked()
+        for cb in fired:
+            cb()
+
+    def set_no_more_pages(self):
+        import time as _time
+
+        with self._lock:
+            if self._no_more:
+                return
+            self._no_more = True
+            self.no_more_ts = _time.monotonic()
+            fired = self._bump_locked()
+        for cb in fired:
+            cb()
+
+    def abort(self):
+        """Failure path: drop pages, mark ended, wake everyone — blocked
+        producers and consumers must all unwind so the query's error can
+        propagate instead of deadlocking."""
+        with self._lock:
+            self._aborted = True
+            self._no_more = True
+            self._pages = [[] for _ in self._pages]
+            fired = self._bump_locked()
+        for cb in fired:
+            cb()
+
+    def full(self, partitions: Optional[Sequence[int]] = None) -> bool:
+        if self.broadcast or self.max_pending_pages is None:
+            return False
+        with self._lock:
+            if self._aborted:
+                return False
+            idxs = range(len(self._pages)) if partitions is None \
+                else partitions
+            for i in idxs:
+                pending = len(self._pages[i]) - self._cursors.get(
+                    (i, "drain"), 0)
+                if pending >= self.max_pending_pages:
+                    return True
+        return False
+
+    # -- streaming consumer side ----------------------------------------
+
+    def poll(self, partition: int, consumer_id: int = 0) -> Optional[Page]:
+        import time as _time
+
+        with self._lock:
+            if self.broadcast:
+                cur = self._cursors.get((0, consumer_id), 0)
+                ps = self._pages[0]
+                if cur < len(ps):
+                    self._cursors[(0, consumer_id)] = cur + 1
+                    page = ps[cur]
+                else:
+                    return None
+            else:
+                cur = self._cursors.get((partition, "drain"), 0)
+                ps = self._pages[partition]
+                if cur < len(ps):
+                    self._cursors[(partition, "drain")] = cur + 1
+                    page = ps[cur]
+                    # single-consumer partition: release the slot so the
+                    # exchange doesn't pin the whole intermediate
+                    # dataset for the query's lifetime
+                    ps[cur] = None
+                else:
+                    return None
+            if self.first_poll_ts is None:
+                self.first_poll_ts = _time.monotonic()
+            fired = self._bump_locked()  # space freed: wake producers
+        for cb in fired:
+            cb()
+        return page
+
+    def at_end(self, partition: int, consumer_id: int = 0) -> bool:
+        with self._lock:
+            if not self._no_more:
+                return False
+            if self.broadcast:
+                return self._cursors.get((0, consumer_id), 0) >= \
+                    len(self._pages[0])
+            return self._cursors.get((partition, "drain"), 0) >= \
+                len(self._pages[partition])
+
+    def has_page(self, partition: int, consumer_id: int = 0) -> bool:
+        with self._lock:
+            if self.broadcast:
+                return self._cursors.get((0, consumer_id), 0) < \
+                    len(self._pages[0])
+            return self._cursors.get((partition, "drain"), 0) < \
+                len(self._pages[partition])
+
+    def channel(self, partition: int, consumer_id: int = 0):
+        return ExchangeChannel(self, partition, consumer_id)
+
+    # -- barrier consumer side (legacy snapshot) -------------------------
 
     def pages(self, partition: int) -> List[Page]:
         with self._lock:
-            return list(self._pages[0 if self.broadcast else partition])
+            return [p for p in
+                    self._pages[0 if self.broadcast else partition]
+                    if p is not None]
 
     @property
     def total_rows(self) -> int:
         with self._lock:
-            return sum(p.num_rows for ps in self._pages for p in ps)
+            return self._total_rows
+
+    @property
+    def overlapped(self) -> bool:
+        """True iff a consumer dequeued a page while producers were
+        still running (the streaming-overlap witness)."""
+        return self.first_poll_ts is not None and (
+            self.no_more_ts is None
+            or self.first_poll_ts < self.no_more_ts)
+
+
+class ExchangeChannel:
+    """One consumer's view of an OutputBuffer partition — the streaming
+    handle ExchangeSourceOperator drives (reference:
+    operator/DirectExchangeClient.java)."""
+
+    __slots__ = ("buffer", "partition", "consumer_id")
+
+    def __init__(self, buffer: OutputBuffer, partition: int,
+                 consumer_id: int):
+        self.buffer = buffer
+        self.partition = partition
+        self.consumer_id = consumer_id
+
+    def poll(self) -> Optional[Page]:
+        return self.buffer.poll(self.partition, self.consumer_id)
+
+    def at_end(self) -> bool:
+        return self.buffer.at_end(self.partition, self.consumer_id)
+
+    def has_page(self) -> bool:
+        return self.buffer.has_page(self.partition, self.consumer_id)
+
+    def listen(self) -> ListenToken:
+        return self.buffer.listen()
 
 
 class PartitionedOutputOperator(Operator):
@@ -74,6 +278,19 @@ class PartitionedOutputOperator(Operator):
         self.kind = kind
         self._done = False
         self._lut_cache: Dict[tuple, np.ndarray] = {}
+
+    def needs_input(self) -> bool:
+        # backpressure: stall the pipeline while any destination
+        # partition has too many undrained pages
+        return not self._finishing and not self.buffer.full()
+
+    def blocked_token(self):
+        if self._finishing:
+            return None
+        # snapshot-then-recheck: a drain between full() and listen()
+        # must not park us on a version that never moves again
+        token = self.buffer.listen()
+        return token if self.buffer.full() else None
 
     def add_input(self, page: DevicePage):
         n = self.buffer.num_partitions
@@ -120,21 +337,80 @@ class PartitionedOutputOperator(Operator):
 
 class ExchangeSourceOperator(SourceOperator):
     """Reads this task's partition of an upstream fragment's output
-    (reference: operator/ExchangeOperator.java). Pages from different
-    producer tasks may carry different dictionary pools — string columns
-    re-encode into one pool via Page.concat."""
+    (reference: operator/ExchangeOperator.java).
 
-    def __init__(self, pages_thunk: Callable[[], List[Page]],
-                 types_: Sequence[T.Type]):
-        self._thunk = pages_thunk
+    Two source modes, decided by what the planner's exchange_reader
+    hands over:
+    - a CALLABLE (barrier mode): a thunk returning the full page list
+      once the producing stage finished; string columns re-encode into
+      one pool via Page.concat;
+    - an object with ``poll``/``at_end``/``listen`` (streaming mode,
+      e.g. ExchangeChannel): pages are consumed as producers enqueue
+      them, each re-encoded INCREMENTALLY into stable per-channel pools
+      (downstream kernels require one pool per channel across pages);
+      when no page is available the operator reports a blocked token so
+      the task executor parks the task instead of spinning."""
+
+    def __init__(self, pages_thunk, types_: Sequence[T.Type]):
+        self._streaming = hasattr(pages_thunk, "poll")
+        self._chan = pages_thunk if self._streaming else None
+        self._thunk = None if self._streaming else pages_thunk
         self.types = list(types_)
         self._pages: Optional[List[Page]] = None
         self._done = False
+        #: streaming: the stable target pool per pooled channel — the
+        #: first arriving page's pool; later pages remap into it
+        self._target_dicts: List[Optional[Dictionary]] = \
+            [None] * len(self.types)
 
     def add_split(self, split):
         raise AssertionError("exchange source has no splits")
 
+    def blocked_token(self):
+        if self._streaming and not self._done:
+            token = self._chan.listen()
+            # re-check AFTER snapshotting the version: a page/no_more
+            # arriving between poll() and listen() must not park us
+            if self._chan.at_end() or self._chan.has_page():
+                return None
+            return token
+        return None
+
+    def _reencode(self, page: Page) -> Page:
+        """Remap pooled columns into the stable target pools (host-side
+        LUT gathers; target pools grow via Dictionary.code)."""
+        blocks = []
+        changed = False
+        for c, t in enumerate(self.types):
+            b = page.block(c).numpy()
+            if not t.is_pooled or b.dictionary is None:
+                blocks.append(b)
+                continue
+            tgt = self._target_dicts[c]
+            if tgt is None:
+                self._target_dicts[c] = b.dictionary
+                blocks.append(b)
+                continue
+            if b.dictionary is tgt:
+                blocks.append(b)
+                continue
+            remap = (np.asarray(tgt.encode(list(b.dictionary.values)),
+                                dtype=np.int32)
+                     if len(b.dictionary) else np.zeros(1, np.int32))
+            blocks.append(Block(t, remap[b.data], b.nulls, tgt))
+            changed = True
+        return Page(blocks, page.num_rows) if changed else page
+
     def get_output(self) -> Optional[DevicePage]:
+        if self._streaming:
+            item = self._chan.poll()
+            if item is not None:
+                if isinstance(item, DevicePage):
+                    return item  # device collective: pools pre-unified
+                return DevicePage.from_page(self._reencode(item))
+            if self._chan.at_end():
+                self._done = True
+            return None
         if self._pages is None:
             items = self._thunk()
             if items and isinstance(items[0], DevicePage):

@@ -69,6 +69,34 @@ class DeviceExchange:
         self._result: Optional[List[List[DevicePage]]] = None
         self.a2a_retries = 0
         self.collective_ran = False  # test observability
+        # streaming-scheduler support: the collective is a barrier — it
+        # needs every producer's rows — so consumers park on a listen
+        # token until the runner signals set_no_more_pages()
+        self._no_more = False
+        self._listeners: List = []
+
+    def set_no_more_pages(self):
+        with self._lock:
+            if self._no_more:
+                return
+            self._no_more = True
+            fired = list(self._listeners)
+            self._listeners.clear()
+        for cb in fired:
+            cb()
+
+    def abort(self):
+        with self._lock:
+            self._no_more = True
+            self._result = [[] for _ in range(self.n)]
+            self._by_task.clear()
+            fired = list(self._listeners)
+            self._listeners.clear()
+        for cb in fired:
+            cb()
+
+    def channel(self, partition: int) -> "DeviceExchangeChannel":
+        return DeviceExchangeChannel(self, partition)
 
     #: process-wide count of executed collectives (dryrun/test
     #: observability); guarded by _total_lock — instances have their own
@@ -247,6 +275,51 @@ def _exchange_program(mesh: Mesh, types_: tuple, key_channels: tuple,
     return jax.jit(prog)
 
 
+class _DeviceExchangeToken:
+    """Listen token over the exchange's producers-done event."""
+
+    __slots__ = ("_ex",)
+
+    def __init__(self, ex: DeviceExchange):
+        self._ex = ex
+
+    def on_ready(self, cb):
+        with self._ex._lock:
+            if not self._ex._no_more:
+                self._ex._listeners.append(cb)
+                return
+        cb()
+
+
+class DeviceExchangeChannel:
+    """Streaming-consumer adapter: parks until ALL producers finished
+    (the collective is inherently a barrier), then streams the
+    partition's DevicePages."""
+
+    def __init__(self, ex: DeviceExchange, partition: int):
+        self.ex = ex
+        self.partition = partition
+        self._pages: Optional[List[DevicePage]] = None
+
+    def poll(self):
+        if not self.ex._no_more:
+            return None
+        if self._pages is None:
+            self._pages = list(self.ex.pages(self.partition))
+        return self._pages.pop(0) if self._pages else None
+
+    def at_end(self) -> bool:
+        return self.ex._no_more and self._pages is not None \
+            and not self._pages
+
+    def has_page(self) -> bool:
+        return self.ex._no_more and (self._pages is None
+                                     or len(self._pages) > 0)
+
+    def listen(self):
+        return _DeviceExchangeToken(self.ex)
+
+
 class DeviceExchangeSinkOperator:
     """Pipeline tail handing DevicePages to the exchange (replaces
     PartitionedOutputOperator on the device path — no host transfer)."""
@@ -263,6 +336,9 @@ class DeviceExchangeSinkOperator:
 
     def needs_input(self) -> bool:
         return not self._finishing
+
+    def blocked_token(self):
+        return None
 
     def add_input(self, page: DevicePage):
         self.exchange.add_page(self.task_id, page)

@@ -130,16 +130,21 @@ class DistributedQueryRunner:
         from ..exec.task_executor import shared_executor
 
         executor = shared_executor()
-        for frag in fragments:
-            ntasks = 1 if frag.partitioning == "single" \
-                else self.n_workers
-            if frag.output_kind == "output":
-                collected = self._run_output_fragment(
-                    executor, frag, root, ntasks, buffers)
-                result_pages = collected
-            else:
-                buffers[frag.fragment_id] = self._run_fragment(
-                    executor, frag, ntasks, buffers)
+        streaming = SP.value(self.session, "streaming_execution")
+        if streaming:
+            result_pages = self._execute_streaming(executor, fragments,
+                                                   root, buffers)
+        else:
+            for frag in fragments:
+                ntasks = 1 if frag.partitioning == "single" \
+                    else self.n_workers
+                if frag.output_kind == "output":
+                    collected = self._run_output_fragment(
+                        executor, frag, root, ntasks, buffers)
+                    result_pages = collected
+                else:
+                    buffers[frag.fragment_id] = self._run_fragment(
+                        executor, frag, ntasks, buffers)
 
         rows: List[tuple] = []
         for p in result_pages:
@@ -147,6 +152,10 @@ class DistributedQueryRunner:
         names = root.column_names
         types_ = [s.type for s in root.outputs]
         stats = {"memory": self._memory_pool.stats()}
+        if streaming:
+            stats["streaming_overlap"] = {
+                fid: buf.overlapped for fid, buf in buffers.items()
+                if isinstance(buf, OutputBuffer)}
         if collect_stats:
             stats["query_stats"] = QueryStatsTree(
                 stages=self._stage_stats,
@@ -154,12 +163,116 @@ class DistributedQueryRunner:
                 memory=self._memory_pool.stats())
         return QueryResult(names, types_, rows, stats=stats)
 
+    # ----------------------------------------------- streaming mode ----
+
+    def _execute_streaming(self, executor, fragments, root: OutputNode,
+                           buffers: Dict[int, "OutputBuffer"]):
+        """All stages run CONCURRENTLY: every fragment's tasks are
+        submitted at once, exchange sources consume pages as producers
+        enqueue them (parking on listen tokens while empty), and
+        bounded buffers push backpressure upstream (reference:
+        execution/scheduler/PipelinedQueryScheduler.java:155)."""
+        import threading
+
+        from ..exec.stats import StageStatsTree
+
+        max_pending = SP.value(self.session, "exchange_max_pending_pages")
+        plans = []
+        for frag in fragments:
+            ntasks = 1 if frag.partitioning == "single" \
+                else self.n_workers
+            out = None
+            if frag.output_kind != "output":
+                device_ex = self._device_exchange_for(frag, ntasks)
+                if device_ex is not None:
+                    out = device_ex
+                elif frag.output_kind == "single":
+                    out = OutputBuffer(1, max_pending_pages=max_pending)
+                elif frag.output_kind == "broadcast":
+                    out = OutputBuffer(self.n_workers, broadcast=True)
+                else:
+                    out = OutputBuffer(self.n_workers,
+                                       max_pending_pages=max_pending)
+                buffers[frag.fragment_id] = out
+            plans.append((frag, ntasks, out))
+
+        futures = []
+        stages = []
+        results: List[List[Page]] = []
+        for frag, ntasks, out in plans:
+            stage = StageStatsTree(frag.fragment_id, frag.partitioning,
+                                   frag.output_kind)
+            stages.append(stage)
+            is_output = frag.output_kind == "output"
+            if is_output:
+                results = [[] for _ in range(ntasks)]
+            # producers-done wiring: the LAST task of the fragment to
+            # exit (normally or not) marks the stream ended, so
+            # consumers always unblock
+            remaining = [ntasks]
+            rlock = threading.Lock()
+
+            def wrapped(gen, out=out, remaining=remaining, rlock=rlock):
+                try:
+                    yield from gen
+                finally:
+                    with rlock:
+                        remaining[0] -= 1
+                        last = remaining[0] == 0
+                    if last and out is not None:
+                        out.set_no_more_pages()
+
+            for t in range(ntasks):
+                gen = self._task_gen(frag, ntasks, t, out, buffers,
+                                     stage, root if is_output else None,
+                                     results if is_output else None,
+                                     streaming=True)
+                futures.append(executor.submit(wrapped(gen)))
+
+        self._wait_all(futures,
+                       [b for b in buffers.values()])
+        if getattr(self, "_collect_stats", False):
+            for stage in stages:
+                stage.tasks.sort(key=lambda t: t.task_id)
+                self._stage_stats.append(stage)
+        return [p for r in results for p in r]
+
+    def _wait_all(self, futures, bufs):
+        """Wait for every task; on the first error, abort all buffers so
+        parked producers/consumers unwind instead of deadlocking, then
+        keep waiting so no generator outlives the query."""
+        errors: List[BaseException] = []
+        aborted = False
+        pending = list(futures)
+        while pending:
+            still = []
+            for f in pending:
+                if f._event.wait(0.02):
+                    if f._error is not None:
+                        errors.append(f._error)
+                else:
+                    still.append(f)
+            if errors and not aborted:
+                aborted = True
+                for b in bufs:
+                    b.abort()
+            pending = still
+        if errors:
+            raise errors[0]
+
     # ------------------------------------------------------------------
 
-    def _make_reader(self, buffers: Dict[int, OutputBuffer], task_id: int):
+    def _make_reader(self, buffers: Dict[int, OutputBuffer], task_id: int,
+                     streaming: bool = False):
         def reader(fragment_id: int, kind: str):
             buf = buffers[fragment_id]
             part = 0 if kind == "single" else task_id
+            if streaming:
+                from .device_exchange import DeviceExchange
+
+                if isinstance(buf, DeviceExchange):
+                    return buf.channel(part)
+                return buf.channel(part, consumer_id=task_id)
 
             def thunk():
                 return buf.pages(part)
@@ -167,6 +280,73 @@ class DistributedQueryRunner:
             return thunk
 
         return reader
+
+    def _task_gen(self, frag: PlanFragment, ntasks: int, t: int, out,
+                  buffers, stage, root: Optional[OutputNode],
+                  results: Optional[List[List[Page]]],
+                  streaming: bool = False):
+        """One task of one fragment as a cooperative generator. ``out``
+        is the fragment's output (OutputBuffer | DeviceExchange | None
+        for the output fragment, which collects into ``results[t]``).
+        In streaming mode a no-progress quantum yields Blocked(tokens)
+        so the executor parks the task."""
+        from ..exec.driver import Driver
+        from ..exec.local_planner import project_to_wire_layout
+        from ..exec.stats import TaskStatsTree
+        from ..exec.task_executor import Blocked
+
+        planner = LocalExecutionPlanner(
+            self.metadata, self.desired_splits, task_id=t,
+            task_count=ntasks,
+            exchange_reader=self._make_reader(buffers, t, streaming),
+            memory_pool=self._memory_pool,
+            join_max_lanes=SP.value(self.session,
+                                    "join_max_expand_lanes"),
+            dynamic_filtering=SP.value(
+                self.session, "enable_dynamic_filtering"))
+        collect = getattr(self, "_collect_stats", False)
+        task = TaskStatsTree(t)
+        if root is not None:
+            plan = planner.plan(OutputNode(frag.root, root.column_names,
+                                           root.outputs))
+            pipelines = plan.pipelines
+        else:
+            ops, layout, types_ = planner.visit(frag.root)
+            ops, layout, types_, key_channels = project_to_wire_layout(
+                frag, ops, layout, types_)
+            from .device_exchange import DeviceExchange
+
+            if isinstance(out, DeviceExchange):
+                from .device_exchange import DeviceExchangeSinkOperator
+
+                ops.append(DeviceExchangeSinkOperator(
+                    types_, key_channels, out, t))
+            else:
+                ops.append(PartitionedOutputOperator(
+                    types_, key_channels, out, frag.output_kind))
+            planner.pipelines.append(PhysicalPipeline(ops))
+            pipelines = planner.pipelines
+        for p in pipelines:
+            d = Driver(p.operators, collect_stats=collect)
+            for _ in range(10_000_000):
+                if d.process():
+                    break
+                if streaming:
+                    # park only after a NO-PROGRESS quantum: a blocked
+                    # source with runnable downstream work must keep
+                    # running
+                    toks = [] if d.last_moved else d.blocked_tokens()
+                    yield Blocked(toks) if toks else None
+                else:
+                    yield  # quantum boundary: hand the thread back
+            else:
+                raise RuntimeError("driver did not finish")
+            if collect:
+                task.operators.extend(d.stats)
+        if root is not None and results is not None:
+            results[t] = plan.sink.pages
+        if collect:
+            stage.tasks.append(task)
 
     def _device_exchange_for(self, frag: PlanFragment, ntasks: int):
         """The flagship TPU-native path: a hash stage boundary between
@@ -206,53 +386,14 @@ class DistributedQueryRunner:
         else:
             out = OutputBuffer(self.n_workers)
 
-        from ..exec.stats import StageStatsTree, TaskStatsTree
+        from ..exec.stats import StageStatsTree
 
         stage = StageStatsTree(frag.fragment_id, frag.partitioning,
                                frag.output_kind)
-
-        def task_gen(t: int):
-            planner = LocalExecutionPlanner(
-                self.metadata, self.desired_splits, task_id=t,
-                task_count=ntasks,
-                exchange_reader=self._make_reader(buffers, t),
-                memory_pool=self._memory_pool,
-                join_max_lanes=SP.value(self.session,
-                                        "join_max_expand_lanes"),
-                dynamic_filtering=SP.value(
-                    self.session, "enable_dynamic_filtering"))
-            ops, layout, types_ = planner.visit(frag.root)
-            from ..exec.local_planner import project_to_wire_layout
-
-            ops, layout, types_, key_channels = project_to_wire_layout(
-                frag, ops, layout, types_)
-            if device_ex is not None:
-                from .device_exchange import DeviceExchangeSinkOperator
-
-                ops.append(DeviceExchangeSinkOperator(
-                    types_, key_channels, device_ex, t))
-            else:
-                ops.append(PartitionedOutputOperator(
-                    types_, key_channels, out, frag.output_kind))
-            planner.pipelines.append(PhysicalPipeline(ops))
-            from ..exec.driver import Driver
-
-            collect = getattr(self, "_collect_stats", False)
-            task = TaskStatsTree(t)
-            for p in planner.pipelines:
-                d = Driver(p.operators, collect_stats=collect)
-                for _ in range(1_000_000):
-                    if d.process():
-                        break
-                    yield  # quantum boundary: hand the thread back
-                else:
-                    raise RuntimeError("driver did not finish")
-                if collect:
-                    task.operators.extend(d.stats)
-            if collect:
-                stage.tasks.append(task)
-
-        executor.run_all([task_gen(t) for t in range(ntasks)])
+        executor.run_all([
+            self._task_gen(frag, ntasks, t, out, buffers, stage, None,
+                           None)
+            for t in range(ntasks)])
         if getattr(self, "_collect_stats", False):
             stage.tasks.sort(key=lambda t: t.task_id)
             self._stage_stats.append(stage)
@@ -261,44 +402,15 @@ class DistributedQueryRunner:
     def _run_output_fragment(self, executor, frag: PlanFragment,
                              root: OutputNode, ntasks: int,
                              buffers) -> List[Page]:
-        from ..exec.stats import StageStatsTree, TaskStatsTree
+        from ..exec.stats import StageStatsTree
 
         results: List[List[Page]] = [[] for _ in range(ntasks)]
         stage = StageStatsTree(frag.fragment_id, frag.partitioning,
                                frag.output_kind)
-
-        def task_gen(t: int):
-            planner = LocalExecutionPlanner(
-                self.metadata, self.desired_splits, task_id=t,
-                task_count=ntasks,
-                exchange_reader=self._make_reader(buffers, t),
-                memory_pool=self._memory_pool,
-                join_max_lanes=SP.value(self.session,
-                                        "join_max_expand_lanes"),
-                dynamic_filtering=SP.value(
-                    self.session, "enable_dynamic_filtering"))
-            plan = planner.plan(OutputNode(frag.root, root.column_names,
-                                           root.outputs))
-            collect = getattr(self, "_collect_stats", False)
-            from ..exec.driver import Driver
-
-            task = TaskStatsTree(t)
-            pages: List[Page] = []
-            for p in plan.pipelines:
-                d = Driver(p.operators, collect_stats=collect)
-                for _ in range(1_000_000):
-                    if d.process():
-                        break
-                    yield
-                else:
-                    raise RuntimeError("driver did not finish")
-                if collect:
-                    task.operators.extend(d.stats)
-            results[t] = plan.sink.pages
-            if collect:
-                stage.tasks.append(task)
-
-        executor.run_all([task_gen(t) for t in range(ntasks)])
+        executor.run_all([
+            self._task_gen(frag, ntasks, t, None, buffers, stage, root,
+                           results)
+            for t in range(ntasks)])
         if getattr(self, "_collect_stats", False):
             stage.tasks.sort(key=lambda t: t.task_id)
             self._stage_stats.append(stage)

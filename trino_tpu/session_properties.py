@@ -70,6 +70,16 @@ register(SessionProperty(
     "pages split in half recursively to stay under this bound",
     lambda v: v >= 1024))
 register(SessionProperty(
+    "streaming_execution", "boolean", True,
+    "Run all stages of a distributed query concurrently with pages "
+    "streaming through exchanges (backpressure + blocked-task parking); "
+    "off = barrier per stage boundary (the fault-tolerant shape)"))
+register(SessionProperty(
+    "exchange_max_pending_pages", "integer", 32,
+    "Streaming backpressure: undrained pages per exchange partition "
+    "before the producing pipeline stalls",
+    lambda v: v >= 1))
+register(SessionProperty(
     "device_exchange", "boolean", True,
     "Run hash exchanges between co-resident stages as an all_to_all "
     "device collective over the mesh (falls back to the host path when "
