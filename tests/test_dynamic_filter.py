@@ -100,3 +100,25 @@ def test_filter_domain_semantics():
     assert keep.tolist() == [False, True, False, True, True, False]
     assert df.pruned_rows == 3
     assert df.scanned_rows == 6
+
+
+def test_dynamic_filter_to_domain():
+    """The build-side key domain interops with the TupleDomain model
+    (round-4: dynamic filters re-expressed on predicate.Domain)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from trino_tpu.exec.dynamic_filter import DynamicFilter
+
+    df = DynamicFilter("t")
+    col = jnp.asarray(np.array([5, 9, 5, 12], dtype=np.int64))
+    nulls = jnp.zeros(4, dtype=bool)
+    valid = jnp.ones(4, dtype=bool)
+    df.collect(col, nulls, valid)
+    dom = df.to_domain()
+    assert dom.includes(5) and dom.includes(9) and dom.includes(12)
+    assert not dom.includes(7) and not dom.includes(None)
+
+    empty = DynamicFilter("e")
+    empty.collect(col, jnp.ones(4, dtype=bool), valid)  # all null keys
+    assert empty.to_domain().is_none

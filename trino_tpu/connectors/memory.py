@@ -71,6 +71,17 @@ class MemoryMetadata(ConnectorMetadata):
             return TableHandle(self.conn.catalog_name, schema, table)
         return None
 
+    def apply_filter(self, table: TableHandle, constraint):
+        """Row-level enforcement over the stored pages (reference:
+        ConnectorMetadata.applyFilter)."""
+        from .spi import negotiate_constraint
+
+        data = self.conn.tables.get((table.schema, table.table))
+        if data is None:
+            return None
+        return negotiate_constraint(table, constraint,
+                                    (c.name for c in data.columns))
+
     def get_columns(self, table: TableHandle) -> List[ColumnHandle]:
         return self.conn.tables[(table.schema, table.table)].columns
 
@@ -144,6 +155,14 @@ class MemoryConnector(Connector):
         with data.lock:
             mine = data.pages[split.row_start::stride] if data.pages else []
         ordinals = [c.ordinal for c in columns]
+        cons = split.table.constraint
+        if cons is not None:
+            from .spi import enforce_constraint_page
+
+            names = [c.name for c in data.columns]
+            return FixedPageSource([
+                enforce_constraint_page(p, names, cons, ordinals)
+                for p in mine])
         return FixedPageSource([p.select_channels(ordinals) for p in mine])
 
     def page_sink(self, table: TableHandle,

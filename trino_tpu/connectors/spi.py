@@ -14,7 +14,7 @@ row-range so distributed scans are reproducible regardless of split count.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 from .. import types as T
 from ..block import Page
@@ -32,10 +32,93 @@ class TableHandle:
     catalog: str
     schema: str
     table: str
+    #: TupleDomain over column NAMES the connector agreed to enforce
+    #: (apply_filter attaches it; page sources mask rows under it)
+    constraint: Optional[object] = None
 
     @property
     def qualified_name(self) -> str:
         return f"{self.catalog}.{self.schema}.{self.table}"
+
+
+def negotiate_constraint(table: "TableHandle", constraint,
+                         names) -> Optional[Tuple["TableHandle", object]]:
+    """The standard full-enforcement apply_filter body shared by the
+    generator/memory connectors: accept the offered domains that name
+    real columns, intersect with any constraint already on the handle,
+    and report FULL enforcement (remaining = all). Returns None when
+    nothing new would be enforced (stops planner loops)."""
+    from dataclasses import replace as _dc_replace
+
+    from ..predicate import TupleDomain
+
+    if constraint.is_none or constraint.is_all:
+        return None
+    names = set(names)
+    accepted = {k: d for k, d in constraint.as_dict().items()
+                if k in names}
+    if not accepted:
+        return None
+    offer = TupleDomain.of(accepted)
+    combined = table.constraint.intersect(offer) \
+        if table.constraint is not None else offer
+    if combined == table.constraint:
+        return None
+    return _dc_replace(table, constraint=combined), TupleDomain.all_()
+
+
+def constrained_gen_columns(columns: Sequence[str],
+                            constraint) -> List[str]:
+    """Projected columns plus any constrained-but-pruned columns a
+    generator must also produce so the row mask can be evaluated."""
+    if constraint is None or constraint.is_all:
+        return list(columns)
+    have = set(columns)
+    return list(columns) + [n for n, _ in (constraint.columns or ())
+                            if n not in have]
+
+
+def enforce_constraint_page(page: Page, names: Sequence[str], constraint,
+                            project: Optional[Sequence[int]] = None
+                            ) -> Page:
+    """Shared row-level constraint enforcement for connectors: mask rows
+    under a TupleDomain keyed by column NAME (evaluated positionally
+    against ``names``), then optionally project to a channel subset.
+    This is what an apply_filter acceptance promises the engine."""
+    from ..block import Block
+    from ..predicate import domain_mask
+
+    if constraint is None or constraint.is_none:
+        doms = {}
+        empty = constraint is not None
+    else:
+        doms = constraint.as_dict()
+        empty = False
+    mask = None
+    if empty:
+        import numpy as np
+
+        mask = np.zeros(page.num_rows, dtype=bool)
+    else:
+        for i, n in enumerate(names):
+            d = doms.get(n)
+            if d is None or d.is_all:
+                continue
+            b = page.block(i).numpy()
+            m = domain_mask(b.data, b.nulls, b.dictionary, d)
+            mask = m if mask is None else (mask & m)
+    blocks = page.blocks if project is None \
+        else [page.blocks[i] for i in project]
+    if mask is None or mask.all():
+        return page if project is None else Page(list(blocks),
+                                                 page.num_rows)
+    out = []
+    for b in blocks:
+        b = b.numpy()
+        out.append(Block(b.type, b.data[mask],
+                         b.nulls[mask] if b.nulls is not None else None,
+                         b.dictionary))
+    return Page(out, int(mask.sum()))
 
 
 @dataclass(frozen=True)
@@ -99,6 +182,16 @@ class ConnectorMetadata:
 
     def get_statistics(self, table: TableHandle) -> TableStatistics:
         return TableStatistics()
+
+    def apply_filter(self, table: TableHandle, constraint
+                     ) -> Optional[Tuple[TableHandle, object]]:
+        """Pushdown negotiation (reference:
+        spi/connector/ConnectorMetadata.java applyFilter): offered a
+        TupleDomain over column NAMES, return (new_handle,
+        remaining_domain) — the handle carrying what the connector will
+        enforce, and the part it cannot (TupleDomain.all_() when fully
+        enforced) — or None to decline entirely."""
+        return None
 
     # -- DDL (reference: ConnectorMetadata createTable/dropTable) ------
 

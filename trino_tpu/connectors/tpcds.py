@@ -802,13 +802,25 @@ class TpcdsPageSource(ConnectorPageSource):
         self.pos = split.row_start
         self.end = split.row_end
         self.page_rows = page_rows
+        from .spi import constrained_gen_columns
+
+        self.constraint = split.table.constraint
+        self.gen_columns = constrained_gen_columns(self.columns,
+                                                   self.constraint)
 
     def get_next_page(self) -> Optional[Page]:
         if self.pos >= self.end:
             return None
         end = min(self.pos + self.page_rows, self.end)
-        page = self.table.generate(self.sf, self.pos, end, self.columns)
+        page = self.table.generate(self.sf, self.pos, end,
+                                   self.gen_columns)
         self.pos = end
+        if self.constraint is not None:
+            from .spi import enforce_constraint_page
+
+            page = enforce_constraint_page(
+                page, self.gen_columns, self.constraint,
+                project=range(len(self.columns)))
         return page
 
     def is_finished(self) -> bool:
@@ -829,6 +841,15 @@ class TpcdsMetadata(ConnectorMetadata):
         if schema in _SCHEMAS and table in _TABLE_COLUMNS:
             return TableHandle(self.conn.catalog_name, schema, table)
         return None
+
+    def apply_filter(self, table: TableHandle, constraint):
+        """Full row-level enforcement at generation, like the TPC-H
+        connector (reference: ConnectorMetadata.applyFilter)."""
+        from .spi import negotiate_constraint
+
+        return negotiate_constraint(
+            table, constraint,
+            (n for n, _ in _TABLE_COLUMNS[table.table]))
 
     def get_columns(self, table: TableHandle) -> List[ColumnHandle]:
         return [ColumnHandle(n, t, i) for i, (n, t)

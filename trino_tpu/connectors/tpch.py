@@ -518,13 +518,28 @@ class TpchPageSource(ConnectorPageSource):
         self.pos = split.row_start
         self.end = split.row_end
         self.page_rows = page_rows
+        # pushed-down constraint: also generate the constrained columns
+        # (they may have been pruned from the projection), mask, then
+        # project back down
+        from .spi import constrained_gen_columns
+
+        self.constraint = split.table.constraint
+        self.gen_columns = constrained_gen_columns(self.columns,
+                                                   self.constraint)
 
     def get_next_page(self) -> Optional[Page]:
         if self.pos >= self.end:
             return None
         end = min(self.pos + self.page_rows, self.end)
-        page = self.table.generate(self.sf, self.pos, end, self.columns)
+        page = self.table.generate(self.sf, self.pos, end,
+                                   self.gen_columns)
         self.pos = end
+        if self.constraint is not None:
+            from .spi import enforce_constraint_page
+
+            page = enforce_constraint_page(
+                page, self.gen_columns, self.constraint,
+                project=range(len(self.columns)))
         return page
 
     def is_finished(self) -> bool:
@@ -549,6 +564,18 @@ class TpchMetadata(ConnectorMetadata):
     def get_columns(self, table: TableHandle) -> List[ColumnHandle]:
         return [ColumnHandle(n, t, i) for i, (n, t)
                 in enumerate(_TABLE_COLUMNS[table.table])]
+
+    def apply_filter(self, table: TableHandle, constraint):
+        """Accept any domain over real columns for FULL row-level
+        enforcement at page generation (reference:
+        plugin/trino-tpch/.../TpchMetadata.java applyFilter; there only
+        orderstatus/type/container prune, here the generator masks any
+        column)."""
+        from .spi import negotiate_constraint
+
+        return negotiate_constraint(
+            table, constraint,
+            (n for n, _ in _TABLE_COLUMNS[table.table]))
 
     def get_statistics(self, table: TableHandle) -> TableStatistics:
         """Row counts plus the per-column ndv / min-max the cost model

@@ -113,6 +113,24 @@ class DynamicFilter:
             else self._seen_dev + seen
         return keep
 
+    def to_domain(self):
+        """The collected build-side key domain as a ``predicate.Domain``
+        — the engine's TupleDomain interop form (reference:
+        DynamicFilterService handing TupleDomains to connector scans).
+        NaN admission can't be expressed as a range and stays a device-
+        side flag; the device ``apply`` path remains the enforcement."""
+        from ..predicate import Domain, Range, ValueSet
+
+        if not self.ready:
+            return Domain.all_()
+        if self.lo > self.hi:  # no finite build keys
+            return Domain.none()
+        if self._values is not None and self._values.shape[0] <= 1024:
+            uniq = np.unique(self._values)
+            return Domain(ValueSet.of(*(v.item() for v in uniq)), False)
+        return Domain(ValueSet.of_ranges(
+            Range(self.lo.item(), True, self.hi.item(), True)), False)
+
     # -- observability ---------------------------------------------------
 
     @property

@@ -63,7 +63,8 @@ class DistributedQueryRunner:
         root = planner.plan(stmt)
         from .. import session_properties as SP
 
-        root = optimize(root, self.metadata, planner.allocator)
+        root = optimize(root, self.metadata, planner.allocator,
+                        self.session)
         root = add_exchanges(
             root, self.metadata, planner.allocator,
             self.broadcast_threshold,

@@ -33,8 +33,8 @@ FILTER_SELECTIVITY = 0.33
 
 
 def optimize(root: OutputNode, metadata: Metadata,
-             allocator: SymbolAllocator) -> OutputNode:
-    opt = Optimizer(metadata, allocator)
+             allocator: SymbolAllocator, session=None) -> OutputNode:
+    opt = Optimizer(metadata, allocator, session)
     node = opt.push_filters(root.source, [])
     node = opt.prune(node, {s.name for s in root.outputs})
     node = opt.cleanup(node)
@@ -42,9 +42,17 @@ def optimize(root: OutputNode, metadata: Metadata,
 
 
 class Optimizer:
-    def __init__(self, metadata: Metadata, allocator: SymbolAllocator):
+    def __init__(self, metadata: Metadata, allocator: SymbolAllocator,
+                 session=None):
         self.metadata = metadata
         self.allocator = allocator
+        if session is None:
+            self.filter_pushdown = True
+        else:
+            from .. import session_properties as SP
+
+            self.filter_pushdown = SP.value(session,
+                                            "filter_pushdown_enabled")
 
     # ------------------------------------------------------------------
     # predicate pushdown + join building
@@ -100,8 +108,11 @@ class Optimizer:
             clone = _replace_source(node, src)
             return clone
 
+        if isinstance(node, TableScanNode):
+            return self._push_into_scan(node, preds)
+
         if isinstance(node, (TopNNode, LimitNode, UnionNode, IntersectNode,
-                             ExceptNode, ValuesNode, TableScanNode)):
+                             ExceptNode, ValuesNode)):
             new_sources = [self.push_filters(s, []) for s in node.sources]
             clone = _replace_sources(node, new_sources)
             return _apply(clone, preds)
@@ -114,6 +125,57 @@ class Optimizer:
         new_sources = [self.push_filters(s, []) for s in node.sources]
         clone = _replace_sources(node, new_sources)
         return _apply(clone, preds)
+
+    # -- pushdown negotiation -------------------------------------------
+
+    def _push_into_scan(self, node: TableScanNode,
+                        preds: List[RowExpression]) -> PlanNode:
+        """Offer the extractable part of ``preds`` to the connector as a
+        TupleDomain (reference: PushPredicateIntoTableScan.java +
+        ConnectorMetadata.applyFilter). Conjuncts whose domains the
+        connector fully enforces are DROPPED (extraction is exact);
+        declined or partial offers keep every conjunct — re-filtering
+        enforced rows is a semantic no-op."""
+        if not preds or not self.filter_pushdown:
+            return _apply(node, preds)
+        conn = self.metadata.connectors.get(node.catalog)
+        if conn is None:
+            return _apply(node, preds)
+        from ..predicate import TupleDomain
+        from .domain_translator import conjunct_domain
+
+        sym_to_col = {s.name: c.name for s, c in node.assignments}
+        col_domains: Dict[str, object] = {}
+        dropped, kept = [], []
+        for p in preds:
+            got = conjunct_domain(p)
+            cname = sym_to_col.get(got[0]) if got is not None else None
+            if got is None or cname is None:
+                kept.append(p)
+                continue
+            dom = got[1]
+            col_domains[cname] = col_domains[cname].intersect(dom) \
+                if cname in col_domains else dom
+            dropped.append(p)
+        if not col_domains:
+            return _apply(node, preds)
+        offer = TupleDomain.of(col_domains)
+        if offer.is_none:
+            # contradiction: let the plain filter produce zero rows
+            return _apply(node, preds)
+        applied = conn.metadata().apply_filter(node.table, offer)
+        if applied is None:
+            return _apply(node, preds)
+        new_handle, remaining = applied
+        if remaining is not None and not remaining.is_all:
+            # the engine only accepts FULL enforcement for now: a
+            # partially-enforcing handle would both carry the constraint
+            # (scaling scan stats) and keep the conjuncts (scaling
+            # filter stats) — double-counting the same predicate
+            return _apply(node, preds)
+        new_scan = TableScanNode(node.catalog, new_handle,
+                                 list(node.assignments))
+        return _apply(new_scan, kept)
 
     # -- join region ----------------------------------------------------
 

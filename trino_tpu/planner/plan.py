@@ -451,6 +451,21 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
     if isinstance(node, TableScanNode):
         detail = f" {node.table.qualified_name}" \
                  f" {[s.name for s, _ in node.assignments]}"
+        cons = getattr(node.table, "constraint", None)
+        if cons is not None and cons.columns:
+            parts = []
+            for cname, dom in cons.columns:
+                rng = "∅" if dom.values.is_none else (
+                    "*" if dom.values.is_all
+                    else ",".join(
+                        (f"{r.low!r}" if r.is_single else
+                         f"{'[' if r.low_inclusive else '('}"
+                         f"{r.low!r},{r.high!r}"
+                         f"{']' if r.high_inclusive else ')'}")
+                        for r in dom.values.ranges))
+                parts.append(f"{cname}:{rng}"
+                             + ("+null" if dom.null_allowed else ""))
+            detail += " constraint{" + " ".join(parts) + "}"
     elif isinstance(node, FilterNode):
         detail = f" {node.predicate!r}"
     elif isinstance(node, ProjectNode):

@@ -121,6 +121,19 @@ class StatsCalculator:
                 null_fraction=cs.null_fraction or 0.0,
                 low=_as_float(cs.min_value),
                 high=_as_float(cs.max_value))
+        # a pushed-down constraint prunes at the scan: its selectivity
+        # must keep scaling the estimate even though the filter
+        # conjuncts left the plan (join ordering depends on it)
+        cons = getattr(node.table, "constraint", None)
+        if cons is not None and cons.columns:
+            for cname, dom in cons.columns:
+                cs = tstats.columns.get(cname) if tstats.columns else None
+                ss = SymbolStats(
+                    distinct_count=cs.distinct_count if cs else None,
+                    null_fraction=(cs.null_fraction or 0.0) if cs else 0.0,
+                    low=_as_float(cs.min_value) if cs else None,
+                    high=_as_float(cs.max_value) if cs else None)
+                rows *= _domain_selectivity(dom, ss)
         return PlanStats(rows, syms, tstats.row_count is not None)
 
     def _s_ValuesNode(self, node: ValuesNode) -> PlanStats:
@@ -292,6 +305,43 @@ class StatsCalculator:
         if name == "$is_null":
             return ss.null_fraction
         return UNKNOWN_FILTER_SELECTIVITY
+
+
+def _domain_selectivity(dom, ss: SymbolStats) -> float:
+    """Selectivity of a pushed-down Domain, mirroring _selectivity's
+    formulas (1/ndv per discrete value; range-overlap fraction over
+    [low, high]) so join ordering sees the same estimates whether a
+    predicate sits in a FilterNode or in a scan constraint."""
+    live = 1.0 - ss.null_fraction
+    if dom.values.is_none:
+        sel = 0.0
+    elif dom.values.is_all:
+        sel = live
+    elif all(r.is_single for r in dom.values.ranges):
+        if ss.distinct_count:
+            sel = live * min(1.0, len(dom.values.ranges)
+                             / max(ss.distinct_count, 1.0))
+        else:
+            sel = UNKNOWN_FILTER_SELECTIVITY
+    else:
+        if ss.low is not None and ss.high is not None \
+                and ss.high > ss.low:
+            frac = 0.0
+            for r in dom.values.ranges:
+                lo = _as_float(r.low) if r.low is not None else ss.low
+                hi = _as_float(r.high) if r.high is not None else ss.high
+                if lo is None or hi is None:
+                    frac = None
+                    break
+                frac += max(0.0, (min(hi, ss.high) - max(lo, ss.low))
+                            / (ss.high - ss.low))
+            sel = live * min(1.0, frac) \
+                if frac is not None else UNKNOWN_FILTER_SELECTIVITY
+        else:
+            sel = UNKNOWN_FILTER_SELECTIVITY
+    if dom.null_allowed:
+        sel += ss.null_fraction
+    return max(0.0, min(sel, 1.0))
 
 
 def _as_literal(expr) -> Optional[Literal]:

@@ -81,7 +81,8 @@ class LocalQueryRunner:
     def plan_statement(self, stmt: ast.Statement) -> OutputNode:
         planner = LogicalPlanner(self.metadata, self.session)
         root = planner.plan(stmt)
-        return optimize(root, self.metadata, planner.allocator)
+        return optimize(root, self.metadata, planner.allocator,
+                        self.session)
 
     def explain(self, sql: str) -> str:
         stmt = parse_statement(sql)

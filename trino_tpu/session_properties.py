@@ -70,6 +70,11 @@ register(SessionProperty(
     "pages split in half recursively to stay under this bound",
     lambda v: v >= 1024))
 register(SessionProperty(
+    "filter_pushdown_enabled", "boolean", True,
+    "Offer extractable filter conjuncts to connectors as TupleDomains "
+    "(ConnectorMetadata.apply_filter); enforced domains drop from the "
+    "plan and prune at the scan"))
+register(SessionProperty(
     "streaming_execution", "boolean", True,
     "Run all stages of a distributed query concurrently with pages "
     "streaming through exchanges (backpressure + blocked-task parking); "
