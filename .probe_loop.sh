@@ -1,0 +1,13 @@
+#!/bin/bash
+# Re-probe the axon TPU tunnel every 10 min; leave a marker when up.
+cd /root/repo
+for i in $(seq 1 70); do
+  timeout -k 10 120 python -c "import jax; d=jax.devices(); print('BACKEND_OK', [str(x) for x in d])" > /root/repo/.tpu_probe_out 2>&1
+  if grep -q BACKEND_OK /root/repo/.tpu_probe_out; then
+    date -u +%FT%TZ > /root/repo/.tpu_up
+    cat /root/repo/.tpu_probe_out >> /root/repo/.tpu_up
+    exit 0
+  fi
+  date -u +%FT%TZ >> /root/repo/.tpu_probe_log
+  sleep 600
+done
