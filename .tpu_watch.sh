@@ -1,0 +1,15 @@
+#!/bin/bash
+# Round-5 TPU tunnel watcher: probe every 10 min; when the tunnel is up,
+# touch .tpu_up and run bench.py (real chip) capturing output.
+cd /root/repo
+while true; do
+  date -u +%Y-%m-%dT%H:%M:%SZ >> .tpu_probe_log
+  if timeout 150 python -c "import jax; d=jax.devices(); assert any('cpu' not in str(x).lower() for x in d); print('TPU_OK', d)" > /tmp/tpu_probe_out 2>&1; then
+    touch .tpu_up
+    echo "TPU UP at $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> .tpu_probe_log
+    timeout 1800 python bench.py > BENCH_tpu_live.json 2> /tmp/bench_tpu_err.log
+    echo "bench rc=$? at $(date -u +%Y-%m-%dT%H:%M:%SZ)" >> .tpu_probe_log
+    sleep 1800
+  fi
+  sleep 600
+done

@@ -97,3 +97,35 @@ def test_device_exchange_chosen_for_hash(conn):
                       DeviceExchange)
     # task-count mismatch -> host fallback
     assert r._device_exchange_for(frag, r.n_workers + 1) is None
+
+
+@pytest.mark.parametrize("n_devices", [1, 2])
+@pytest.mark.parametrize("sql", QUERIES)
+def test_fewer_devices_than_partitions(conn, monkeypatch, sql, n_devices):
+    """Single-chip degeneracy: p partitions on d < p devices (partition
+    p lives on device p % d, ids carried through the collective). The
+    flagship path must EXECUTE — not fall back — and match the host
+    path. Ref: operator/output/PartitionedOutputOperator.java (which has
+    no such coupling because its buffers are host-side)."""
+    import jax
+
+    real = jax.devices()
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a, **k: real[:n_devices])
+    ran = []
+    orig = DeviceExchange._collect
+
+    def spying_collect(self):
+        assert self.d == min(n_devices, self.n)
+        out = orig(self)
+        ran.append(self.collective_ran)
+        return out
+
+    monkeypatch.setattr(DeviceExchange, "_collect", spying_collect)
+    dev = _runner(conn, True)
+    drows = sorted(dev.execute(sql).rows, key=_key)
+    monkeypatch.undo()
+    host = _runner(conn, False)
+    hrows = sorted(host.execute(sql).rows, key=_key)
+    assert drows == hrows
+    assert any(ran), "device exchange fell back to host path"

@@ -213,9 +213,12 @@ def q1_device_step(input_types: List[T.Type]):
         state_cols = []
         for a in aggs:
             state_cols.extend(_init_states(a, pcols, pnulls, pvalid))
+        from .ops.pallas_kernels import pallas_mode
+
         return _group_reduce(tuple(key_ops), key_raws, tuple(state_cols),
                              pvalid, num_keys=2,
-                             num_states=len(state_cols), kinds=kinds)
+                             num_states=len(state_cols), kinds=kinds,
+                             pallas=pallas_mode())
 
     return proc, step
 

@@ -301,9 +301,11 @@ def _final_project(agg: AggCall, states: List):
 # the grouping kernel
 
 
-@partial(jax.jit, static_argnames=("num_states", "num_keys", "kinds"))
+@partial(jax.jit, static_argnames=("num_states", "num_keys", "kinds",
+                                   "pallas"))
 def _group_reduce(key_ops: Tuple, key_raws: Tuple, state_cols: Tuple,
-                  valid, num_keys: int, num_states: int, kinds: Tuple):
+                  valid, num_keys: int, num_states: int, kinds: Tuple,
+                  pallas: str = ""):
     """Sort-group-reduce one batch.
 
     key_ops: flattened (null_bit, u64) pairs for each group key
@@ -334,14 +336,14 @@ def _group_reduce(key_ops: Tuple, key_raws: Tuple, state_cols: Tuple,
     # invalid lanes -> dump segment
     gid = jnp.where(s_valid, gid, cap)
 
+    # the hot scatter: Pallas kernel on TPU (lax segment ops elsewhere)
+    # — see ops/pallas_kernels.py
+    from .pallas_kernels import segment_reduce
+
     reduced = []
     for kind, col in zip(kinds, s_states):
-        if kind == "sum":
-            r = jax.ops.segment_sum(col, gid, num_segments=cap + 1)
-        elif kind == "min":
-            r = jax.ops.segment_min(col, gid, num_segments=cap + 1)
-        else:
-            r = jax.ops.segment_max(col, gid, num_segments=cap + 1)
+        r = segment_reduce(col, gid, num_segments=cap + 1, kind=kind,
+                           mode=pallas)
         reduced.append(r[:cap])
 
     # group keys: first sorted row of each segment
@@ -495,10 +497,13 @@ class HashAggregationOperator(Operator):
                                                page.valid,
                                                page.dictionaries))
 
+        from .pallas_kernels import pallas_mode
+
         out_keys, out_key_nulls, reduced, out_valid = _group_reduce(
             tuple(key_ops), tuple(key_raws), tuple(state_cols), page.valid,
             num_keys=len(self.group_channels),
-            num_states=len(state_cols), kinds=self._kinds)
+            num_states=len(state_cols), kinds=self._kinds,
+            pallas=pallas_mode())
 
         # string min/max: reduced RANK -> representative CODE in the
         # captured pool (dead/sentinel lanes clamp; count==0 nulls them)

@@ -59,9 +59,15 @@ class DeviceExchange:
     """
 
     def __init__(self, n_partitions: int, devices: Sequence):
-        assert len(devices) >= n_partitions
+        # p-partitions-on-d-devices layout: with fewer devices than
+        # partitions (a single real chip being the important case),
+        # partition p lives on device p % d; partition ids are carried
+        # through the collective and consumers split their device's slab
+        # by mask. d == n degenerates to the exact 1:1 mapping.
+        assert len(devices) >= 1
         self.n = n_partitions
-        self.devices = list(devices)[:n_partitions]
+        self.devices = list(devices)[:min(n_partitions, len(devices))]
+        self.d = len(self.devices)
         self.types: Optional[List[T.Type]] = None
         self.key_channels: Optional[List[int]] = None
         self._by_task: Dict[int, List[DevicePage]] = {}
@@ -138,7 +144,7 @@ class DeviceExchange:
     # -- the collective -------------------------------------------------
 
     def _collect(self) -> List[List[DevicePage]]:
-        n, types_ = self.n, self.types
+        n, d, types_ = self.n, self.d, self.types
         if types_ is None or not self._by_task:
             return [[] for _ in range(n)]
         nch = len(types_)
@@ -163,10 +169,13 @@ class DeviceExchange:
                     cols[c] = jnp.asarray(remap)[p.cols[c]]
             return cols
 
-        # stack per-task rows (padded lanes + valid masks carried as-is)
-        task_caps = [sum(p.capacity for p in self._by_task.get(t, []))
-                     for t in range(n)]
-        cap = padded_size(max(max(task_caps), 16))
+        # stack per-DEVICE rows (padded lanes + valid masks carried
+        # as-is): producer task t's pages land in device slab t % d
+        dev_pages: List[List[DevicePage]] = [[] for _ in range(d)]
+        for t in sorted(self._by_task):
+            dev_pages[t % d].extend(self._by_task[t])
+        dev_caps = [sum(p.capacity for p in ps) for ps in dev_pages]
+        cap = padded_size(max(max(dev_caps), 16))
         total_rows = 0
         s_cols = [[] for _ in range(nch)]
         s_nulls = [[] for _ in range(nch)]
@@ -179,8 +188,7 @@ class DeviceExchange:
             return jnp.concatenate(
                 [a, jnp.zeros((cap - k,), dtype=a.dtype)])
 
-        for t in range(n):
-            ps = self._by_task.get(t, [])
+        for ps in dev_pages:
             total_rows += sum(p.count() for p in ps)
             page_cols = [unified_cols(p) for p in ps]
             for c in range(nch):
@@ -209,12 +217,13 @@ class DeviceExchange:
                      for c in self.key_channels if types_[c].is_string)
 
         mesh = Mesh(np.asarray(self.devices), ("x",))
-        per_dest = padded_size(max(32, (2 * cap) // n))
+        per_dest = padded_size(max(32, (2 * cap) // d))
         while True:
             prog = _exchange_program(mesh, tuple(types_),
-                                     tuple(self.key_channels), n, per_dest)
-            out_cols, out_nulls, out_valid, overflow = prog(cols, nulls,
-                                                            valid, luts)
+                                     tuple(self.key_channels), n, d,
+                                     per_dest)
+            out_cols, out_nulls, out_valid, out_part, overflow = prog(
+                cols, nulls, valid, luts)
             jax.block_until_ready(out_valid)
             if int(np.asarray(overflow).sum()) == 0:
                 break
@@ -233,25 +242,34 @@ class DeviceExchange:
         self._by_task.clear()
         out_dicts = list(target)
         result: List[List[DevicePage]] = []
-        for t in range(n):
+        for p in range(n):
+            dev = p % d
+            pv = out_valid[dev]
+            if d < n:  # split the device slab by carried partition id
+                pv = pv & (out_part[dev] == p)
             dp = DevicePage(list(types_),
-                            [c[t] for c in out_cols],
-                            [x[t] for x in out_nulls],
-                            out_valid[t], out_dicts)
+                            [c[dev] for c in out_cols],
+                            [x[dev] for x in out_nulls],
+                            pv, out_dicts)
             result.append([dp])
         return result
 
 
 @lru_cache(maxsize=128)
 def _exchange_program(mesh: Mesh, types_: tuple, key_channels: tuple,
-                      n: int, per_dest: int):
+                      n: int, d: int, per_dest: int):
     """Build the jitted SPMD shuffle: normalize keys -> partition ids ->
-    bucket-sort -> all_to_all. Memoized on (mesh, types, keys, n,
-    per_dest) so repeat shapes reuse the compiled program."""
+    bucket-sort -> all_to_all. Memoized on (mesh, types, keys, n, d,
+    per_dest) so repeat shapes reuse the compiled program.
+
+    With d < n the collective routes to DEVICE p % d and the partition id
+    rides along as an extra carried channel so the consumer can split its
+    slab; with d == n device == partition and the carry is still returned
+    (cheap) but unused."""
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P("x"), P("x"), P("x"), P(None)),
-             out_specs=(P("x"), P("x"), P("x"), P("x")),
+             out_specs=(P("x"), P("x"), P("x"), P("x"), P("x")),
              check_vma=False)
     def prog(cols, nulls, valid, luts):
         cols = tuple(c[0] for c in cols)
@@ -266,11 +284,14 @@ def _exchange_program(mesh: Mesh, types_: tuple, key_channels: tuple,
                 li += 1
             keys.append(key_to_u64(cols[c], nulls[c], types_[c], lut))
         part = hash_partition_ids(keys, n)
+        dest = part % d if d < n else part
+        false_ = jnp.zeros(valid.shape, dtype=bool)
         ex_cols, ex_nulls, ex_valid, overflow = repartition_a2a(
-            cols, nulls, valid, part, num_partitions=n, per_dest=per_dest)
-        return (tuple(c[None] for c in ex_cols),
-                tuple(x[None] for x in ex_nulls),
-                ex_valid[None], overflow[None])
+            cols + (part,), nulls + (false_,), valid, dest,
+            num_partitions=d, per_dest=per_dest)
+        return (tuple(c[None] for c in ex_cols[:-1]),
+                tuple(x[None] for x in ex_nulls[:-1]),
+                ex_valid[None], ex_cols[-1][None], overflow[None])
 
     return jax.jit(prog)
 

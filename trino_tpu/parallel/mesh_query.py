@@ -101,9 +101,12 @@ def q1_mesh_fn(mesh: Mesh, proc, step, aggs, per_dest: int):
             merged.extend(_merge_states(
                 a, [ex_cols[idx + j] for j in range(k)], ex_valid))
             idx += k
+        from ..ops.pallas_kernels import pallas_mode
+
         out_keys, out_key_nulls, reduced, out_valid = _group_reduce(
             tuple(key_ops), tuple(ex_cols[:2]), tuple(merged), ex_valid,
-            num_keys=2, num_states=len(merged), kinds=kinds)
+            num_keys=2, num_states=len(merged), kinds=kinds,
+            pallas=pallas_mode())
         fin_cols = list(out_keys)
         fin_nulls = [jnp.asarray(x) for x in out_key_nulls]
         idx = 0
