@@ -1,0 +1,118 @@
+"""Memo-based iterative optimizer: rules, exploration, join ordering.
+
+Reference analog: the IterativeOptimizer/Memo tests
+(``sql/planner/iterative/``) and ``TestReorderJoins`` — rule fixpoint
+per group, pattern matching through the lookup, cost-based join-order
+exploration with provenance in EXPLAIN.
+"""
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.resources.tpch_queries import TPCH_QUERIES
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=2048)},
+                            Session(catalog="tpch", schema="micro"))
+
+
+def test_q9_join_order_explored(runner):
+    """The round-3/4 carried criterion: q9's six-relation region gets a
+    cost-based order — no CrossJoin survives, the selective %green%
+    filter sits under the join against part, and EXPLAIN names the
+    rule."""
+    plan = runner.explain(TPCH_QUERIES[9])
+    assert "CrossJoin" not in plan
+    assert "ReorderJoins" in plan
+    # the selective filter was sunk into its relation (below some join)
+    like_line = [l for l in plan.splitlines() if "like" in l][0]
+    scan_part = [l for l in plan.splitlines()
+                 if "TableScan tpch.micro.part " in l][0]
+    join_lines = [l for l in plan.splitlines() if "Join inner" in l]
+    assert join_lines, plan
+    depth = len(like_line) - len(like_line.lstrip())
+    join_depth = min(len(l) - len(l.lstrip()) for l in join_lines)
+    assert depth > join_depth, "filter not pushed below the join region"
+    assert len(scan_part) - len(scan_part.lstrip()) > depth
+
+
+def test_q9_rows_unchanged_by_reorder(runner):
+    rows = runner.execute(TPCH_QUERIES[9]).rows
+    assert len(rows) == 54
+    assert rows == sorted(rows, key=lambda r: (r[0], -r[1]))
+
+
+def test_provenance_in_explain(runner):
+    plan = runner.explain(
+        "select n_name from nation where n_regionkey = 2 "
+        "order by n_name limit 3")
+    assert "Optimizer rules applied:" in plan
+    assert "PushFilterIntoTableScan" in plan
+
+
+def test_limit_over_sort_becomes_topn(runner):
+    plan = runner.explain(
+        "select o_custkey from orders order by o_totalprice limit 5")
+    assert "TopN" in plan
+    assert "LimitOverSortToTopN" in plan or "Limit" not in plan
+
+
+def test_filter_pushes_through_aggregation(runner):
+    """HAVING-style key conjuncts sink below the aggregation."""
+    plan = runner.explain(
+        "select * from (select l_returnflag f, count(*) c from lineitem "
+        "group by l_returnflag) where f = 'A'")
+    lines = plan.splitlines()
+    agg = [i for i, l in enumerate(lines) if "Aggregation" in l][0]
+    constrained_scan = [i for i, l in enumerate(lines)
+                        if "constraint{l_returnflag" in l]
+    assert constrained_scan and constrained_scan[0] > agg, plan
+    rows = runner.execute(
+        "select * from (select l_returnflag f, count(*) c from lineitem "
+        "group by l_returnflag) where f = 'A'").rows
+    assert rows == [("A", 1590)]
+
+
+def test_exploration_terminates_and_is_idempotent(runner):
+    """Re-optimizing an already-optimal plan must not diverge (the
+    ReorderJoins termination argument: the DP is deterministic with
+    optimal substructure)."""
+    p1 = runner.explain(TPCH_QUERIES[3])
+    p2 = runner.explain(TPCH_QUERIES[3])
+    assert p1 == p2
+
+
+def test_merge_limits_rule():
+    from trino_tpu.planner.memo import (IterativeOptimizer, Lookup,
+                                        Memo, RuleContext)
+    from trino_tpu.planner.plan import LimitNode, ValuesNode
+    from trino_tpu.planner.rules import MergeLimits
+    from trino_tpu.planner.symbols import Symbol
+    from trino_tpu import types as T
+
+    v = ValuesNode([Symbol("x", T.BIGINT)], [])
+    plan = LimitNode(LimitNode(v, 10, 0), 3, 0)
+    memo = Memo()
+    gid = memo.insert(plan)
+    ctx = RuleContext(Lookup(memo), None, None, None)
+    out = MergeLimits().apply(memo.node(gid), ctx)
+    assert isinstance(out, LimitNode) and out.count == 3
+    assert not isinstance(ctx.lookup.resolve(out.source), LimitNode)
+
+
+def test_join_region_through_views(runner):
+    """Regions flatten through group references left by other rules
+    (filters/projections between joins)."""
+    sql = ("select c.c_name, sum(l.l_quantity) q from customer c, "
+           "orders o, lineitem l where c.c_custkey = o.o_custkey and "
+           "o.o_orderkey = l.l_orderkey and c.c_mktsegment = 'BUILDING' "
+           "group by c.c_name order by q desc limit 5")
+    plan = runner.explain(sql)
+    assert "CrossJoin" not in plan
+    assert "ReorderJoins" in plan
+    rows = runner.execute(sql).rows
+    assert len(rows) == 5

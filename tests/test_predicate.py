@@ -227,3 +227,49 @@ def test_float_ne_keeps_nan_rows():
     for r in (on, off):
         rows = r.execute("select count(*) from t where d <> 5.0").rows
         assert rows == [(2,)], rows
+
+
+def test_partial_enforcement_residual_refiltered():
+    """ConstraintApplicationResult semantics: a connector enforcing only
+    ONE of two offered column domains returns the other as the RESIDUAL
+    TupleDomain; the engine keeps filtering that column itself and the
+    answer stays correct (reference:
+    spi/connector/ConstraintApplicationResult.java remainingFilter)."""
+    from trino_tpu.connectors.memory import MemoryConnector, MemoryMetadata
+    from trino_tpu.connectors.spi import negotiate_constraint
+
+    class OneColumnMetadata(MemoryMetadata):
+        offered_cols = []
+
+        def apply_filter(self, table, constraint):
+            OneColumnMetadata.offered_cols.append(
+                sorted(constraint.as_dict().keys()))
+            data = self.conn.tables.get((table.schema, table.table))
+            if data is None:
+                return None
+            # the connector only knows how to prune on 'k'
+            return negotiate_constraint(
+                table, constraint, (c.name for c in data.columns),
+                enforceable={"k"})
+
+    class OneColumnMemory(MemoryConnector):
+        def metadata(self):
+            return OneColumnMetadata(self)
+
+    mem = OneColumnMemory()
+    on, off = _runners({"mem": mem}, "default", "mem")
+    on.execute("create table t (k bigint, v bigint)")
+    on.execute("insert into t values (1, 10), (2, 20), (3, 30), "
+               "(4, 40), (5, 50)")
+    sql = "select k, v from t where k >= 2 and v <= 40"
+    rows_on = sorted(on.execute(sql).rows)
+    assert rows_on == [(2, 20), (3, 30), (4, 40)]
+    assert rows_on == sorted(off.execute(sql).rows)
+    # both domains were offered; only k landed on the handle
+    # both domains were offered together at least once (the iterative
+    # engine may re-offer the residual alone on later passes)
+    assert ["k", "v"] in OneColumnMetadata.offered_cols
+    plan = on.explain(sql)
+    assert "constraint{k" in plan and "constraint{k, v" not in plan
+    # the residual conjunct (v) stays as an engine-side filter
+    assert "v" in plan.split("TableScan")[0]

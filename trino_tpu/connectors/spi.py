@@ -18,6 +18,7 @@ from typing import Iterator, List, Optional, Sequence, Tuple
 
 from .. import types as T
 from ..block import Page
+from ..predicate import TupleDomain
 
 
 @dataclass(frozen=True)
@@ -32,39 +33,47 @@ class TableHandle:
     catalog: str
     schema: str
     table: str
-    #: TupleDomain over column NAMES the connector agreed to enforce
-    #: (apply_filter attaches it; page sources mask rows under it)
-    constraint: Optional[object] = None
+    #: the TupleDomain (over column NAMES) the connector agreed to
+    #: enforce (apply_filter attaches it; page sources mask rows under
+    #: it) — the typed analog of the reference's opaque
+    #: ConnectorTableHandle carrying its enforced constraint
+    constraint: Optional[TupleDomain] = None
 
     @property
     def qualified_name(self) -> str:
         return f"{self.catalog}.{self.schema}.{self.table}"
 
 
-def negotiate_constraint(table: "TableHandle", constraint,
-                         names) -> Optional[Tuple["TableHandle", object]]:
-    """The standard full-enforcement apply_filter body shared by the
-    generator/memory connectors: accept the offered domains that name
-    real columns, intersect with any constraint already on the handle,
-    and report FULL enforcement (remaining = all). Returns None when
-    nothing new would be enforced (stops planner loops)."""
+def negotiate_constraint(table: "TableHandle", constraint: TupleDomain,
+                         names, enforceable=None
+                         ) -> Optional[Tuple["TableHandle", TupleDomain]]:
+    """The standard apply_filter body shared by the generator/memory
+    connectors: accept the offered domains naming real columns the
+    connector can enforce, intersect with any constraint already on the
+    handle, and return the RESIDUAL TupleDomain the engine must keep
+    filtering (reference: ConstraintApplicationResult.java with
+    remainingFilter). ``enforceable`` limits acceptance to a column
+    subset (None = every real column — full enforcement). Returns None
+    when nothing new would be enforced (stops planner loops)."""
     from dataclasses import replace as _dc_replace
-
-    from ..predicate import TupleDomain
 
     if constraint.is_none or constraint.is_all:
         return None
     names = set(names)
-    accepted = {k: d for k, d in constraint.as_dict().items()
-                if k in names}
+    if enforceable is not None:
+        names &= set(enforceable)
+    offered = constraint.as_dict()
+    accepted = {k: d for k, d in offered.items() if k in names}
     if not accepted:
         return None
+    residual = TupleDomain.of({k: d for k, d in offered.items()
+                               if k not in names})
     offer = TupleDomain.of(accepted)
     combined = table.constraint.intersect(offer) \
         if table.constraint is not None else offer
     if combined == table.constraint:
         return None
-    return _dc_replace(table, constraint=combined), TupleDomain.all_()
+    return _dc_replace(table, constraint=combined), residual
 
 
 def constrained_gen_columns(columns: Sequence[str],

@@ -24,7 +24,6 @@ from typing import List, Optional, Tuple
 from .. import types as T
 from ..ops.aggregation import intermediate_state_types
 from .logical_planner import Metadata
-from .optimizer import Optimizer
 from .plan import (AggregationNode, CrossJoinNode, DistinctNode,
                    EnforceSingleRowNode, ExceptNode, ExchangeNode,
                    FilterNode, IntersectNode, JoinNode, LimitNode,
@@ -59,7 +58,6 @@ class ExchangePlanner:
         self.allocator = allocator
         self.broadcast_threshold = broadcast_threshold
         self.join_distribution = join_distribution
-        self._est = Optimizer(metadata, allocator)
         self._stats = StatsCalculator(metadata)
 
     def run(self, root: OutputNode) -> OutputNode:
@@ -236,6 +234,28 @@ class ExchangePlanner:
             src = LimitNode(src, node.count + node.offset, 0)
         ex = ExchangeNode(src, "single", [])
         return LimitNode(ex, node.count, node.offset), SINGLE
+
+    def _v_TableWriterNode(self, node):
+        """Scaled writers: the writer runs in the SOURCE's distribution
+        (one sink per task), per-task rowcounts gather to a single stage
+        that sums them into the statement's row count (reference:
+        TableWriterNode staying in the source stage +
+        TableFinishNode.java summing fragments)."""
+        from .plan import TableWriterNode
+
+        src, dist = self.visit(node.source)
+        writer = TableWriterNode(src, node.catalog, node.schema,
+                                 node.table_name, node.columns,
+                                 node.rows_symbol, node.create)
+        if dist in (SINGLE, ANY):
+            return writer, SINGLE
+        ex = ExchangeNode(writer, "single", [])
+        from .plan import Aggregation, AggregationNode
+
+        total = AggregationNode(
+            ex, [], [(node.rows_symbol,
+                      Aggregation("sum", node.rows_symbol))], "single")
+        return total, SINGLE
 
     def _v_UnionNode(self, node: UnionNode):
         inputs = [self._to_single(*self.visit(s)) for s in node.inputs]

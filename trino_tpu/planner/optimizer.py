@@ -34,11 +34,45 @@ FILTER_SELECTIVITY = 0.33
 
 def optimize(root: OutputNode, metadata: Metadata,
              allocator: SymbolAllocator, session=None) -> OutputNode:
+    """The optimizer pipeline: the memo-based iterative rule engine
+    (predicate/limit pushdown, scan negotiation, cost-based join
+    reordering — planner/memo.py + planner/rules.py), then the ordered
+    column-pruning/cleanup passes (the reference also runs
+    PruneUnreferencedOutputs-style passes outside exploration)."""
+    from .memo import IterativeOptimizer
+    from .rules import default_rules
+
+    engine = IterativeOptimizer(default_rules(), metadata, allocator,
+                                session)
+    node = engine.optimize(root.source)
     opt = Optimizer(metadata, allocator, session)
-    node = opt.push_filters(root.source, [])
     node = opt.prune(node, {s.name for s in root.outputs})
     node = opt.cleanup(node)
-    return OutputNode(node, root.column_names, root.outputs)
+    out = OutputNode(node, root.column_names, root.outputs)
+    #: rule provenance for EXPLAIN (reference: in the Java engine each
+    #: PlanNode carries its source rule via PlanNodeIdAllocator tags)
+    out.optimizer_trace = list(engine.trace)
+    return out
+
+
+def provenance_lines(root: OutputNode) -> List[str]:
+    """Rule-application provenance for EXPLAIN output (dedup'd, with
+    counts and the ReorderJoins order detail)."""
+    trace = getattr(root, "optimizer_trace", None)
+    if not trace:
+        return []
+    lines = ["Optimizer rules applied:"]
+    seen: Dict[str, int] = {}
+    details: Dict[str, str] = {}
+    for name, detail in trace:
+        seen[name] = seen.get(name, 0) + 1
+        if detail:
+            details[name] = detail
+    for name, count in seen.items():
+        suffix = f" x{count}" if count > 1 else ""
+        d = f"  [{details[name]}]" if name in details else ""
+        lines.append(f"  {name}{suffix}{d}")
+    return lines
 
 
 class Optimizer:
@@ -53,284 +87,6 @@ class Optimizer:
 
             self.filter_pushdown = SP.value(session,
                                             "filter_pushdown_enabled")
-
-    # ------------------------------------------------------------------
-    # predicate pushdown + join building
-
-    def push_filters(self, node: PlanNode,
-                     preds: List[RowExpression]) -> PlanNode:
-        """Push ``preds`` (conjuncts from above) as far down as possible;
-        returns rewritten subtree with unplaced conjuncts applied on top."""
-        if isinstance(node, FilterNode):
-            return self.push_filters(node.source,
-                                     preds + conjuncts(node.predicate))
-
-        if isinstance(node, (CrossJoinNode, JoinNode)) and (
-                isinstance(node, CrossJoinNode) or
-                node.join_type == "inner"):
-            return self._build_join_region(node, preds)
-
-        if isinstance(node, JoinNode):
-            # left/semi/anti: push left-only conjuncts into the probe
-            # side. FULL null-extends BOTH sides, so nothing may cross it.
-            left_syms = {s.name for s in node.left.output_symbols}
-            push_left, stay = [], []
-            for p in preds:
-                (push_left if node.join_type != "full"
-                 and referenced_symbols(p) <= left_syms
-                 else stay).append(p)
-            left = self.push_filters(node.left, push_left)
-            right = self.push_filters(node.right, [])
-            out = JoinNode(node.join_type, left, right, node.criteria,
-                           node.filter_expr)
-            return _apply(out, stay)
-
-        if isinstance(node, ProjectNode):
-            # inline assignments into the conjuncts and push them all —
-            # every scalar here is deterministic, so duplication is safe
-            mapping = {s.name: e for s, e in node.assignments}
-            pushable = [rewrite_symbols(p, mapping) for p in preds]
-            src = self.push_filters(node.source, pushable)
-            return ProjectNode(src, node.assignments)
-
-        if isinstance(node, AggregationNode):
-            keys = {s.name for s in node.group_keys}
-            push, stay = [], []
-            for p in preds:
-                (push if referenced_symbols(p) <= keys else stay).append(p)
-            src = self.push_filters(node.source, push)
-            out = AggregationNode(src, node.group_keys, node.aggregations,
-                                  node.step)
-            return _apply(out, stay)
-
-        if isinstance(node, (SortNode, DistinctNode, EnforceSingleRowNode)):
-            src = self.push_filters(node.sources[0], preds)
-            clone = _replace_source(node, src)
-            return clone
-
-        if isinstance(node, TableScanNode):
-            return self._push_into_scan(node, preds)
-
-        if isinstance(node, (TopNNode, LimitNode, UnionNode, IntersectNode,
-                             ExceptNode, ValuesNode)):
-            new_sources = [self.push_filters(s, []) for s in node.sources]
-            clone = _replace_sources(node, new_sources)
-            return _apply(clone, preds)
-
-        if isinstance(node, OutputNode):
-            src = self.push_filters(node.source, preds)
-            return OutputNode(src, node.column_names, node.outputs)
-
-        # default: optimize children, keep conjuncts here
-        new_sources = [self.push_filters(s, []) for s in node.sources]
-        clone = _replace_sources(node, new_sources)
-        return _apply(clone, preds)
-
-    # -- pushdown negotiation -------------------------------------------
-
-    def _push_into_scan(self, node: TableScanNode,
-                        preds: List[RowExpression]) -> PlanNode:
-        """Offer the extractable part of ``preds`` to the connector as a
-        TupleDomain (reference: PushPredicateIntoTableScan.java +
-        ConnectorMetadata.applyFilter). Conjuncts whose domains the
-        connector fully enforces are DROPPED (extraction is exact);
-        declined or partial offers keep every conjunct — re-filtering
-        enforced rows is a semantic no-op."""
-        if not preds or not self.filter_pushdown:
-            return _apply(node, preds)
-        conn = self.metadata.connectors.get(node.catalog)
-        if conn is None:
-            return _apply(node, preds)
-        from ..predicate import TupleDomain
-        from .domain_translator import conjunct_domain
-
-        sym_to_col = {s.name: c.name for s, c in node.assignments}
-        col_domains: Dict[str, object] = {}
-        dropped, kept = [], []
-        for p in preds:
-            got = conjunct_domain(p)
-            cname = sym_to_col.get(got[0]) if got is not None else None
-            if got is None or cname is None:
-                kept.append(p)
-                continue
-            dom = got[1]
-            col_domains[cname] = col_domains[cname].intersect(dom) \
-                if cname in col_domains else dom
-            dropped.append(p)
-        if not col_domains:
-            return _apply(node, preds)
-        offer = TupleDomain.of(col_domains)
-        if offer.is_none:
-            # contradiction: let the plain filter produce zero rows
-            return _apply(node, preds)
-        applied = conn.metadata().apply_filter(node.table, offer)
-        if applied is None:
-            return _apply(node, preds)
-        new_handle, remaining = applied
-        if remaining is not None and not remaining.is_all:
-            # the engine only accepts FULL enforcement for now: a
-            # partially-enforcing handle would both carry the constraint
-            # (scaling scan stats) and keep the conjuncts (scaling
-            # filter stats) — double-counting the same predicate
-            return _apply(node, preds)
-        new_scan = TableScanNode(node.catalog, new_handle,
-                                 list(node.assignments))
-        return _apply(new_scan, kept)
-
-    # -- join region ----------------------------------------------------
-
-    def _build_join_region(self, node: PlanNode,
-                           preds: List[RowExpression]) -> PlanNode:
-        """Flatten nested inner/cross joins into a relation list + conjunct
-        pool, then greedily build a left-deep probe-heavy join tree."""
-        relations: List[PlanNode] = []
-        pool: List[RowExpression] = list(preds)
-
-        def flatten(n: PlanNode):
-            if isinstance(n, CrossJoinNode):
-                flatten(n.left)
-                flatten(n.right)
-            elif isinstance(n, JoinNode) and n.join_type == "inner":
-                flatten(n.left)
-                flatten(n.right)
-                for l, r in n.criteria:
-                    pool.append(Call(T.BOOLEAN, "eq", (l.ref(), r.ref())))
-                if n.filter_expr is not None:
-                    pool.extend(conjuncts(n.filter_expr))
-            elif isinstance(n, FilterNode):
-                pool.extend(conjuncts(n.predicate))
-                flatten(n.source)
-            else:
-                relations.append(n)
-
-        flatten(node)
-
-        # push single-relation conjuncts into their relation
-        rel_syms = [{s.name for s in r.output_symbols} for r in relations]
-        remaining: List[RowExpression] = []
-        per_rel: List[List[RowExpression]] = [[] for _ in relations]
-        for p in pool:
-            refs = referenced_symbols(p)
-            placed = False
-            for i, syms in enumerate(rel_syms):
-                if refs <= syms:
-                    per_rel[i].append(p)
-                    placed = True
-                    break
-            if not placed:
-                remaining.append(p)
-        relations = [self.push_filters(r, ps)
-                     for r, ps in zip(relations, per_rel)]
-        # statistics-based sizes: the calculator applies predicate
-        # selectivity from connector column stats (ndv/min-max), not a
-        # flat per-filter coefficient (reference: cost/StatsCalculator
-        # feeding the join-order rules)
-        from .stats import StatsCalculator
-
-        calc = StatsCalculator(self.metadata)
-        sizes = [calc.stats(r).row_count for r in relations]
-
-        if len(relations) == 1:
-            return _apply(relations[0], remaining)
-
-        # greedy: start from the largest (probe side stays streaming),
-        # then repeatedly join the connected relation whose join yields
-        # the smallest estimated OUTPUT (cost-based, not just smallest
-        # input — reference: ReorderJoins' CostComparator choice)
-        order = sorted(range(len(relations)), key=lambda i: -sizes[i])
-        joined_idx = {order[0]}
-        plan = relations[order[0]]
-        available = {s.name for s in plan.output_symbols}
-        unjoined = [i for i in order[1:]]
-        residuals = list(remaining)
-
-        def equi_edges(avail: Set[str], cand_syms: Set[str]):
-            eqs = []
-            for p in residuals:
-                if isinstance(p, Call) and p.name == "eq":
-                    a, b = p.args
-                    if isinstance(a, SymbolRef) and isinstance(b, SymbolRef):
-                        if a.name in avail and b.name in cand_syms:
-                            eqs.append((Symbol(a.name, a.type),
-                                        Symbol(b.name, b.type), p))
-                        elif b.name in avail and a.name in cand_syms:
-                            eqs.append((Symbol(b.name, b.type),
-                                        Symbol(a.name, a.type), p))
-            return eqs
-
-        while unjoined:
-            best = None  # ((est output rows, build rows), i, eqs)
-            for i in unjoined:
-                cand_syms = rel_syms[i]
-                eqs = equi_edges(available, cand_syms)
-                if eqs:
-                    cand = JoinNode("inner", plan, relations[i],
-                                    [(l, r) for l, r, _ in eqs])
-                    key = (calc.stats(cand).row_count, sizes[i])
-                    if best is None or key < best[0]:
-                        best = (key, i, eqs)
-            if best is None:
-                # no connected relation: cross join the smallest
-                i = min(unjoined, key=lambda j: sizes[j])
-                plan = self._cross_join(plan, relations[i])
-            else:
-                _, i, eqs = best
-                criteria = [(l, r) for l, r, _ in eqs]
-                used = {id(p) for _, _, p in eqs}
-                residuals = [p for p in residuals if id(p) not in used]
-                plan = JoinNode("inner", plan, relations[i], criteria)
-            unjoined.remove(i)
-            available |= rel_syms[i]
-            # attach any residual now fully available
-            attachable = [p for p in residuals
-                          if referenced_symbols(p) <= available]
-            if attachable:
-                residuals = [p for p in residuals if p not in attachable]
-                plan = _apply(plan, attachable)
-        return _apply(plan, residuals)
-
-    def _cross_join(self, left: PlanNode, right: PlanNode) -> JoinNode:
-        """Cross join as an equi join on a constant key (single-row or
-        small build sides only in practice)."""
-        lk = self.allocator.new_symbol("cj", T.BIGINT)
-        rk = self.allocator.new_symbol("cj", T.BIGINT)
-        lproj = ProjectNode(left, [(s, s.ref())
-                                   for s in left.output_symbols]
-                            + [(lk, Literal(T.BIGINT, 0))])
-        rproj = ProjectNode(right, [(s, s.ref())
-                                    for s in right.output_symbols]
-                            + [(rk, Literal(T.BIGINT, 0))])
-        return JoinNode("inner", lproj, rproj, [(lk, rk)])
-
-    def _estimate_rows(self, node: PlanNode, num_filters: int) -> float:
-        base = self._base_rows(node)
-        return base * (FILTER_SELECTIVITY ** num_filters)
-
-    def _base_rows(self, node: PlanNode) -> float:
-        if isinstance(node, TableScanNode):
-            conn = self.metadata.connectors.get(node.catalog)
-            if conn is not None:
-                stats = conn.metadata().get_statistics(node.table)
-                if getattr(stats, "row_count", None):
-                    return float(stats.row_count)
-            return DEFAULT_ROWS
-        if isinstance(node, AggregationNode):
-            return self._base_rows(node.source) * 0.1
-        if isinstance(node, (FilterNode,)):
-            return self._base_rows(node.source) * FILTER_SELECTIVITY
-        if isinstance(node, ValuesNode):
-            return float(len(node.rows))
-        if isinstance(node, EnforceSingleRowNode):
-            return 1.0
-        if isinstance(node, JoinNode):
-            if node.join_type in ("semi", "anti"):
-                return self._base_rows(node.left) * 0.5
-            return max(self._base_rows(node.left),
-                       self._base_rows(node.right))
-        srcs = node.sources
-        if not srcs:
-            return DEFAULT_ROWS
-        return max(self._base_rows(s) for s in srcs)
 
     # ------------------------------------------------------------------
     # column pruning

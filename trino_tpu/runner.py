@@ -85,10 +85,15 @@ class LocalQueryRunner:
                         self.session)
 
     def explain(self, sql: str) -> str:
+        from .planner.optimizer import provenance_lines
+
         stmt = parse_statement(sql)
         if isinstance(stmt, ast.Explain):
             stmt = stmt.statement
-        return plan_tree_str(self.plan_statement(stmt))
+        root = self.plan_statement(stmt)
+        text = plan_tree_str(root)
+        prov = provenance_lines(root)
+        return text + ("\n" + "\n".join(prov) if prov else "")
 
     def execute(self, sql: str) -> QueryResult:
         """Admission (resource group) + access control + event firing
@@ -122,9 +127,15 @@ class LocalQueryRunner:
         if isinstance(stmt, ast.Explain):
             if stmt.analyze:
                 return self._explain_analyze(stmt.statement)
-            text = plan_tree_str(self.plan_statement(stmt.statement))
+            from .planner.optimizer import provenance_lines
+
+            root = self.plan_statement(stmt.statement)
+            lines = plan_tree_str(root).splitlines()
+            prov = provenance_lines(root)
+            if prov:
+                lines.extend([""] + prov)
             return QueryResult(["Query Plan"], [T.VARCHAR],
-                               [(line,) for line in text.splitlines()])
+                               [(line,) for line in lines])
         if isinstance(stmt, ast.SetSession):
             from . import session_properties as SP
             from .exec.local_planner import _eval_literal
