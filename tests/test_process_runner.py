@@ -103,21 +103,121 @@ def test_worker_death_query_retry(local, cluster):
     assert cluster.heartbeat() == [True, False]
 
 
-def test_memory_catalog_routes_to_coordinator():
-    """Memory-connector state lives in the coordinator process only, so
-    queries touching it must run locally, not distribute to workers."""
+def test_streaming_cross_process_overlap(cluster):
+    """The defining streaming witness ACROSS PROCESSES: some mid-plan
+    task's first output page was drained by its consumer (another
+    process) before that task finished (reference:
+    PipelinedQueryScheduler's concurrent stages)."""
+    res = cluster.execute(
+        "select l_returnflag, l_linestatus, count(*), sum(l_quantity) "
+        "from lineitem group by l_returnflag, l_linestatus")
+    overlap = res.stats["process_overlap"]
+    assert len(overlap) >= 2
+    assert any(overlap.values()), \
+        f"no cross-process producer/consumer overlap: {overlap}"
+
+
+def test_concurrent_queries_interleave(cluster):
+    """Two queries submitted through the HTTP protocol run CONCURRENTLY
+    against the worker processes — their execution windows overlap
+    (the coordinator has no per-query serialization lock)."""
+    import threading
+    import time
+
+    from trino_tpu.client import Client
+    from trino_tpu.server.protocol import ProtocolServer
+
+    srv = ProtocolServer(cluster, page_size=1000).start()
+    try:
+        windows = {}
+        errors = []
+
+        def run(tag, sql):
+            c = Client(srv.uri)
+            t0 = time.monotonic()
+            try:
+                res = c.execute(sql)
+                windows[tag] = (t0, time.monotonic(), len(res.rows))
+            except Exception as e:  # surfaces in the main thread
+                errors.append(e)
+
+        sqls = {
+            "a": "select l_returnflag, count(*), sum(l_quantity) "
+                 "from lineitem group by l_returnflag",
+            "b": "select o_orderpriority, count(*) from orders, lineitem "
+                 "where o_orderkey = l_orderkey "
+                 "group by o_orderpriority",
+        }
+        threads = [threading.Thread(target=run, args=(tag, sql))
+                   for tag, sql in sqls.items()]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=300)
+        assert not errors, errors
+        assert windows["a"][2] == 3 and windows["b"][2] == 5
+        a0, a1, _ = windows["a"]
+        b0, b1, _ = windows["b"]
+        assert a0 < b1 and b0 < a1, \
+            f"queries serialized: a={a0, a1} b={b0, b1}"
+    finally:
+        srv.stop()
+
+
+def test_distributed_writes_memory_catalog():
+    """INSERT/CTAS writer tasks execute ON WORKER PROCESSES (page-sink
+    RPC to the coordinator's catalog), commits replicate to every
+    worker, and the written table is then scanned DISTRIBUTED."""
     with ProcessQueryRunner(
             {"tpch": {"connector": "tpch", "page_rows": 4096},
              "memory": {"connector": "memory"}},
             Session(catalog="memory", schema="default"),
-            n_workers=1, desired_splits=2) as c:
-        c.execute("create table t as select n_nationkey k, n_name "
-                  "from tpch.micro.nation")
-        res = c.execute("select count(*) from t")
+            n_workers=2, desired_splits=4) as c:
+        res = c.execute("create table t as select n_nationkey k, n_name "
+                        "from tpch.micro.nation")
         assert res.rows == [(25,)]
+        res = c.execute("insert into t select n_nationkey + 100, n_name "
+                        "from tpch.micro.nation where n_regionkey = 2")
+        assert res.rows == [(5,)]
+        # distributed read of the replicated table joins a distributed
+        # catalog — the scan runs on the workers, not the coordinator
+        res = c.execute("select count(*) from t")
+        assert res.rows == [(30,)]
+        res = c.execute(
+            "select r_name, count(*) c from t, tpch.micro.nation n, "
+            "tpch.micro.region r where t.k % 100 = n.n_nationkey and "
+            "n.n_regionkey = r.r_regionkey group by r_name "
+            "order by c desc, r_name")
+        assert res.rows[0][1] == 10  # ASIA nations counted twice
+        res = c.execute("delete from t where k >= 100")
+        assert res.rows == [(5,)]
+        assert c.execute("select count(*) from t").rows == [(25,)]
         # distributed catalogs still distribute
         res2 = c.execute("select count(*) from tpch.micro.region")
         assert res2.rows[0][0] == 5
+        # retried writes must not double-apply: pages stage at the
+        # coordinator and only the SUCCESSFUL attempt commits
+        c.inject_task_failure("q", times=1)
+        res = c.execute("insert into t select n_nationkey + 200, n_name "
+                        "from tpch.micro.nation where n_regionkey = 0")
+        assert res.rows == [(5,)]
+        assert c.execute("select count(*) from t").rows == [(30,)]
+
+
+def test_barrier_mode_task_retry():
+    """With streaming off (fault-tolerant barrier shape), an injected
+    task failure retries on ANOTHER worker without restarting the
+    query."""
+    s = Session(catalog="tpch", schema="micro")
+    s.properties["streaming_execution"] = False
+    with ProcessQueryRunner(CATALOGS, s, n_workers=2, desired_splits=4,
+                            broadcast_threshold=300.0) as c:
+        c.inject_task_failure("q", times=1)
+        res = c.execute("select l_returnflag, count(*) from lineitem "
+                        "group by l_returnflag")
+        assert sorted(res.rows) == [("A", 1590), ("N", 2773),
+                                    ("R", 1516)]
+        assert not any(c.failure_injections.values())
 
 
 def test_serde_roundtrip():

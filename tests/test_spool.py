@@ -1,0 +1,122 @@
+"""Spooled exchange + retry-from-spool (fault-tolerant execution).
+
+Reference analog: ``spi/exchange/ExchangeManager.java:42-75`` +
+``FileSystemExchangeManager`` under RetryPolicy.TASK — durable stage
+outputs so a failed task retries WITHOUT re-running its producer stage.
+"""
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.block import Page
+from trino_tpu.parallel.process_runner import ProcessQueryRunner
+from trino_tpu.parallel.spool import (ExchangeSink,
+                                      FileSystemExchangeManager,
+                                      read_spool)
+from trino_tpu.sql.analyzer import Session
+
+CATALOGS = {"tpch": {"connector": "tpch", "page_rows": 4096}}
+
+
+def test_spool_roundtrip(tmp_path):
+    mgr = FileSystemExchangeManager(str(tmp_path))
+    sink0 = mgr.create_sink("q1", 0, task=0, n_partitions=2)
+    sink1 = mgr.create_sink("q1", 0, task=1, n_partitions=2)
+    p = Page.from_pylists([T.BIGINT, T.VARCHAR],
+                          [[1, 2], ["a", "b"]])
+    sink0.add(0, p)
+    sink0.add(1, p)
+    sink1.add(1, p)
+    sink0.finish()
+    sink1.finish()
+    d = mgr.exchange_dir("q1", 0)
+    assert [pg.to_rows() for pg in read_spool(d, 0)] == [p.to_rows()]
+    assert len(read_spool(d, 1)) == 2  # both tasks contributed
+    mgr.remove_exchange("q1", 0)
+    with pytest.raises(FileNotFoundError):
+        read_spool(d, 0)
+
+
+def test_unfinished_sink_not_visible(tmp_path):
+    """A sink that never finished (producer died) must leave nothing
+    readable — write-then-rename atomicity."""
+    mgr = FileSystemExchangeManager(str(tmp_path))
+    sink = mgr.create_sink("q2", 0, task=0, n_partitions=1)
+    sink.add(0, Page.from_pylists([T.BIGINT], [[1]]))
+    # no finish()
+    assert read_spool(mgr.exchange_dir("q2", 0), 0) == []
+    sink.abort()
+
+
+@pytest.fixture(scope="module")
+def ft_cluster():
+    s = Session(catalog="tpch", schema="micro")
+    s.properties["streaming_execution"] = False
+    s.properties["retry_policy"] = "TASK"
+    with ProcessQueryRunner(CATALOGS, s, n_workers=2, desired_splits=4,
+                            broadcast_threshold=300.0) as c:
+        yield c
+
+
+SQL = ("select l_returnflag, l_linestatus, count(*), sum(l_quantity) "
+       "from lineitem group by l_returnflag, l_linestatus")
+EXPECTED_GROUPS = 4
+
+
+def test_task_retry_does_not_rerun_producer(ft_cluster):
+    """The retry-from-spool contract: inject a failure into a FINAL-
+    stage task; the retry replays its input from the spool and the
+    PRODUCER stage's tasks are launched exactly once."""
+    c = ft_cluster
+    qid = f"q{c._task_seq + 1}a0"
+    c.inject_task_failure(f"{qid}.f1", times=1)
+    c.task_launches.clear()
+    res = c.execute(SQL)
+    assert len(res.rows) == EXPECTED_GROUPS
+    assert not any(c.failure_injections.values())
+    f0 = [t for t in c.task_launches if f"{qid}.f0." in t]
+    f1 = [t for t in c.task_launches if f"{qid}.f1." in t]
+    assert len(f0) == 2, f"producer stage re-ran: {f0}"
+    assert len(f1) == 3, f"expected one retried final task: {f1}"
+
+
+def test_worker_death_recovers_from_spool(ft_cluster):
+    """Kill a worker BETWEEN stages mid-query: the dead worker's final-
+    stage task retries on the survivor reading the spooled producer
+    output — the producer stage (partly run by the dead worker) is NOT
+    re-run and the query is NOT restarted."""
+    c = ft_cluster
+    qid = f"q{c._task_seq + 1}a0"
+    c.task_launches.clear()
+
+    # arrange the kill after fragment 0 completes: monkey-style hook on
+    # _run_fragment via failure injection is worker-side; instead kill
+    # on first f1 launch by watching task_launches from a thread is
+    # racy — simplest deterministic lever: kill the worker right before
+    # execute of a SECOND query's final stage is impossible, so instead
+    # run once to warm, then kill and verify the running query survives
+    # via task retry on the survivor.
+    import threading
+    import time
+
+    victim = c.workers[1]
+
+    def killer():
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if any(f"{qid}.f1." in t for t in c.task_launches):
+                victim.proc.kill()
+                return
+            time.sleep(0.001)
+
+    th = threading.Thread(target=killer)
+    th.start()
+    res = c.execute(SQL)
+    th.join()
+    assert len(res.rows) == EXPECTED_GROUPS
+    # query-level retry would show a second attempt id (a1); spool
+    # retry keeps every launch inside attempt 0
+    assert all("a0." in t for t in c.task_launches), c.task_launches
+    f0 = [t for t in c.task_launches if f"{qid}.f0." in t]
+    assert len(f0) == 2, f"producer stage re-ran: {f0}"
+    c.heartbeat()

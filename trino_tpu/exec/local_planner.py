@@ -36,6 +36,18 @@ from ..planner.symbols import Symbol, to_input_refs
 from ..types import TrinoError
 
 
+def create_table_idempotent(conn, schema: str, table: str, columns):
+    """Execution-time CTAS create that tolerates losing the race to a
+    sibling writer task (the analyzer already rejected genuinely
+    pre-existing targets)."""
+    try:
+        return conn.metadata().create_table(schema, table, columns)
+    except TrinoError as e:
+        if e.code != "TABLE_ALREADY_EXISTS":
+            raise
+        return conn.metadata().get_table_handle(schema, table)
+
+
 class PhysicalPipeline:
     """One operator chain; drivers run pipelines in list order (upstream
     build/union pipelines first)."""
@@ -74,7 +86,8 @@ class LocalExecutionPlanner:
                  task_id: int = 0, task_count: int = 1,
                  exchange_reader=None, memory_pool=None,
                  join_max_lanes: Optional[int] = None,
-                 dynamic_filtering: bool = True):
+                 dynamic_filtering: bool = True,
+                 page_sink_factory=None):
         self.metadata = metadata
         self.desired_splits = desired_splits
         self.task_id = task_id
@@ -83,6 +96,10 @@ class LocalExecutionPlanner:
         self.memory_pool = memory_pool
         self.join_max_lanes = join_max_lanes
         self.dynamic_filtering = dynamic_filtering
+        #: override for write sinks: ``factory(TableWriterNode) -> sink``
+        #: — the multi-process runtime routes worker writes to the
+        #: coordinator's catalog through this (page-sink RPC)
+        self.page_sink_factory = page_sink_factory
         self.pipelines: List[PhysicalPipeline] = []
         # scan-node id -> [(channel, DynamicFilter)] attachments
         self._scan_dfs: Dict[int, List] = {}
@@ -419,16 +436,23 @@ class LocalExecutionPlanner:
         from ..ops.operator import TableWriterOperator
 
         ops, layout, types_ = self.visit(node.source)
-        conn = self.metadata.connectors[node.catalog]
-        if node.create:
-            # CTAS creates the target here, at execution time — EXPLAIN
-            # and failed planning never mutate metadata
-            handle = conn.metadata().create_table(
-                node.schema, node.table_name, node.columns)
+        if self.page_sink_factory is not None:
+            sink = self.page_sink_factory(node)
         else:
-            handle = conn.metadata().get_table_handle(node.schema,
-                                                      node.table_name)
-        sink = conn.page_sink(handle, node.columns)
+            conn = self.metadata.connectors[node.catalog]
+            if node.create:
+                # CTAS creates the target here, at execution time —
+                # EXPLAIN and failed planning never mutate metadata.
+                # Scaled writers: sibling tasks of a distributed CTAS
+                # race to create; the analyzer already rejected genuine
+                # pre-existing targets, so losing the race means a
+                # sibling won — use its table
+                handle = create_table_idempotent(
+                    conn, node.schema, node.table_name, node.columns)
+            else:
+                handle = conn.metadata().get_table_handle(node.schema,
+                                                          node.table_name)
+            sink = conn.page_sink(handle, node.columns)
         ops.append(TableWriterOperator(sink))
         return ops, {node.rows_symbol.name: 0}, [T.BIGINT]
 

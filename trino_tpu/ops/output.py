@@ -237,6 +237,15 @@ class OutputBuffer:
             or self.first_poll_ts < self.no_more_ts)
 
 
+def wait_readable(buffer: OutputBuffer, timeout: float = 0.25):
+    """Block the calling thread until the buffer's state version moves
+    (page enqueued/drained, no-more, abort) or the timeout passes — the
+    thread-world adapter used by the worker's long-poll result server."""
+    ev = threading.Event()
+    buffer.listen().on_ready(ev.set)
+    ev.wait(timeout)
+
+
 class ExchangeChannel:
     """One consumer's view of an OutputBuffer partition — the streaming
     handle ExchangeSourceOperator drives (reference:

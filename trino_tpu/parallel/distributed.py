@@ -65,17 +65,24 @@ class DistributedQueryRunner:
 
         root = optimize(root, self.metadata, planner.allocator,
                         self.session)
+        trace = getattr(root, "optimizer_trace", None)
         root = add_exchanges(
             root, self.metadata, planner.allocator,
             self.broadcast_threshold,
             SP.value(self.session, "join_distribution_type"))
+        if trace is not None:  # exchange planning rebuilt the root node
+            root.optimizer_trace = trace
         self._root = root
         self._fragments = fragment_plan(root)
         return self._fragments
 
     def explain(self, sql: Optional[str], stmt=None) -> str:
-        return fragments_str(self.create_fragments(
+        from ..planner.optimizer import provenance_lines
+
+        text = fragments_str(self.create_fragments(
             stmt if stmt is not None else sql))
+        prov = provenance_lines(self._root)
+        return text + ("\n" + "\n".join(prov) if prov else "")
 
     def execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
@@ -83,7 +90,11 @@ class DistributedQueryRunner:
                 isinstance(stmt.statement, ast.QueryStatement):
             return self._explain_analyze(stmt.statement)
         if not isinstance(stmt, ast.QueryStatement):
-            # non-query statements don't distribute; delegate
+            if isinstance(stmt, (ast.Insert, ast.CreateTableAsSelect)):
+                # writes distribute: scaled writer tasks in the source
+                # stage, rowcounts summed (exchanges._v_TableWriterNode)
+                return self._execute_query(stmt)
+            # remaining DDL doesn't distribute; delegate
             from ..runner import LocalQueryRunner
 
             return LocalQueryRunner(self.metadata.connectors,

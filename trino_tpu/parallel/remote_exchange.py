@@ -1,0 +1,269 @@
+"""Streaming cross-process exchange client + remote page sink.
+
+Reference analog: ``operator/DirectExchangeClient.java:55`` — the
+consumer-side client that concurrently long-polls every upstream task's
+output buffer, acknowledges what it received so the producer can free
+it, and exposes a non-blocking page stream to the ExchangeOperator. Here
+the transport is the framed-RPC ``get_page_stream`` op (worker.py) and
+the hand-off to the driver is the same poll/at_end/listen channel
+contract the in-process streaming exchange uses (ops/output.py), so the
+local planner cannot tell a remote stage boundary from a local one.
+
+Backpressure is end-to-end: the producer's OutputBuffer is bounded (its
+driver parks when full), this client drains it over the wire into a
+bounded local queue, and the consuming driver parks on the channel's
+listen token while the queue is empty.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..exec.serde import PageDeserializer, PageSerializer
+
+
+class ExchangeConnectionLost(RuntimeError):
+    """An upstream worker died or its task buffers vanished: the stream
+    cannot be completed. Tagged so the coordinator can classify the
+    failure as retry-the-query rather than a user error (reference:
+    RetryPolicy.QUERY on DirectExchange failures)."""
+
+
+class _ChannelToken:
+    __slots__ = ("_chan", "_version")
+
+    def __init__(self, chan: "RemoteExchangeChannel", version: int):
+        self._chan = chan
+        self._version = version
+
+    def on_ready(self, cb):
+        with self._chan._lock:
+            if self._chan._version == self._version:
+                self._chan._listeners.append(cb)
+                return
+        cb()
+
+
+class RemoteExchangeChannel:
+    """One consumer's streaming view of an upstream fragment spread over
+    remote tasks. A background fetcher round-robins the upstream tasks
+    with short long-polls, deserializing into a bounded local queue."""
+
+    def __init__(self, locations: List[Tuple[tuple, str]], partition: int,
+                 consumer_id: int = 0, max_local: int = 16,
+                 poll_wait: float = 0.5):
+        self.partition = partition
+        self.consumer_id = consumer_id
+        self.max_local = max_local
+        self.poll_wait = poll_wait
+        self._lock = threading.Lock()
+        self._queue: List = []
+        self._version = 0
+        self._listeners: List = []
+        self._ended = False
+        self._error: Optional[BaseException] = None
+        self._stop = False
+        self._drained = threading.Event()
+        self._pending = [(tuple(addr), task_id)
+                         for addr, task_id in locations]
+        self._des: Dict[str, PageDeserializer] = {
+            task_id: PageDeserializer() for _, task_id in self._pending}
+        self._thread = threading.Thread(target=self._fetch_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    # -- fetcher ---------------------------------------------------------
+
+    def _fetch_loop(self):
+        from .rpc import recv_frame, recv_msg, send_msg
+        import socket
+
+        try:
+            while not self._stop and self._pending:
+                progressed = False
+                for addr, task_id in list(self._pending):
+                    if self._stop:
+                        return
+                    # local backpressure: don't outrun the consumer
+                    while not self._stop and self._qsize() >= self.max_local:
+                        self._drained.clear()
+                        if self._qsize() >= self.max_local:
+                            self._drained.wait(0.2)
+                    if self._stop:
+                        return
+                    try:
+                        with socket.create_connection(addr,
+                                                      timeout=60) as sock:
+                            send_msg(sock, {
+                                "op": "get_page_stream",
+                                "task_id": task_id,
+                                "partition": self.partition,
+                                "consumer_id": self.consumer_id,
+                                "wait": self.poll_wait})
+                            head = recv_msg(sock)
+                            frames = [recv_frame(sock)
+                                      for _ in range(head.get("n_pages", 0))]
+                    except OSError as e:
+                        raise ExchangeConnectionLost(
+                            f"pull from {addr} task {task_id}: {e!r}")
+                    if head.get("error"):
+                        msg = head["error"]
+                        if head.get("connection_lost") or \
+                                "[connection-lost]" in msg:
+                            raise ExchangeConnectionLost(msg)
+                        raise RuntimeError(
+                            f"upstream task {task_id} failed: {msg}")
+                    if frames:
+                        de = self._des[task_id]
+                        pages = [de.deserialize(f) for f in frames]
+                        with self._lock:
+                            self._queue.extend(pages)
+                            fired = self._bump_locked()
+                        for cb in fired:
+                            cb()
+                        progressed = True
+                    if head.get("done"):
+                        self._pending.remove((addr, task_id))
+                        progressed = True
+                if not progressed and not self._pending:
+                    break
+            with self._lock:
+                self._ended = True
+                fired = self._bump_locked()
+            for cb in fired:
+                cb()
+        except BaseException as e:
+            with self._lock:
+                self._error = e
+                self._ended = True
+                fired = self._bump_locked()
+            for cb in fired:
+                cb()
+
+    def _qsize(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def _bump_locked(self):
+        self._version += 1
+        fired = list(self._listeners)
+        self._listeners.clear()
+        return fired
+
+    # -- channel contract (ops/output.ExchangeChannel) -------------------
+
+    def poll(self):
+        with self._lock:
+            if self._queue:
+                page = self._queue.pop(0)
+                self._drained.set()
+                return page
+            if self._error is not None:
+                raise self._error
+        return None
+
+    def at_end(self) -> bool:
+        with self._lock:
+            if self._error is not None:
+                raise self._error
+            return self._ended and not self._queue
+
+    def has_page(self) -> bool:
+        with self._lock:
+            return bool(self._queue) or self._error is not None
+
+    def listen(self):
+        with self._lock:
+            return _ChannelToken(self, self._version)
+
+    def close(self):
+        self._stop = True
+        self._drained.set()
+        self._thread.join(timeout=5)
+
+
+class RemotePageSink:
+    """Worker-side write sink that ships written pages to the
+    coordinator's catalog over RPC (reference: the page-sink half of
+    ``operator/TableWriterOperator.java`` against a remote metastore —
+    the memory catalog's single source of truth lives with the
+    coordinator, which then replicates to workers)."""
+
+    def __init__(self, coordinator: tuple, catalog: str, schema: str,
+                 table: str, task_id: str = "", batch_pages: int = 8):
+        self.coordinator = tuple(coordinator)
+        self.catalog, self.schema, self.table = catalog, schema, table
+        #: the writing task attempt: the coordinator STAGES pages under
+        #: it and commits only the successful attempt's stage when the
+        #: query completes — retries cannot double-write
+        self.task_id = task_id
+        self.batch_pages = batch_pages
+        self._ser = PageSerializer()
+        self._frames: List[bytes] = []
+        self.rows = 0
+
+    def append_page(self, page):
+        self._frames.append(self._ser.serialize(page))
+        self.rows += page.num_rows
+        if len(self._frames) >= self.batch_pages:
+            self._flush()
+
+    def _flush(self):
+        from .rpc import call
+
+        if not self._frames:
+            return
+        resp = call(self.coordinator, {
+            "op": "sink_pages", "catalog": self.catalog,
+            "schema": self.schema, "table": self.table,
+            "task": self.task_id, "frames": self._frames})
+        if not resp.get("ok"):
+            raise RuntimeError(f"coordinator sink rejected pages: "
+                               f"{resp.get('error')}")
+        self._frames = []
+
+    def finish(self) -> dict:
+        self._flush()
+        return {"rows": self.rows}
+
+
+def wait_tokens(tokens, timeout: float = 0.25):
+    """Block the calling thread until any listen token fires (or the
+    timeout passes) — the thread-world adapter for the cooperative
+    Blocked protocol the in-process TaskExecutor uses."""
+    ev = threading.Event()
+    for t in tokens:
+        t.on_ready(ev.set)
+    ev.wait(timeout)
+
+
+def run_driver_blocking(driver, abort: threading.Event,
+                        max_idle_s: float = 600.0):
+    """Drive one pipeline to completion in a dedicated thread, parking
+    on listen tokens after no-progress quanta (the process-world twin of
+    DistributedQueryRunner._task_gen's streaming loop)."""
+    idle_since = None
+    while True:
+        if abort.is_set():
+            raise RuntimeError("task aborted")
+        if driver.process():
+            return
+        if driver.last_moved:
+            idle_since = None
+            continue
+        toks = driver.blocked_tokens()
+        if toks:
+            wait_tokens(toks, timeout=0.25)
+            idle_since = None
+        else:
+            # runnable but idle quantum (e.g. operator waiting on an
+            # internal condition): spin gently, bounded
+            now = time.monotonic()
+            if idle_since is None:
+                idle_since = now
+            elif now - idle_since > max_idle_s:
+                raise RuntimeError("driver made no progress for "
+                                   f"{max_idle_s}s (stuck pipeline?)")
+            time.sleep(0.002)

@@ -1,0 +1,118 @@
+"""Spooled (external) exchange: durable stage outputs for task retry.
+
+Reference analog: the exchange SPI ``spi/exchange/ExchangeManager.java:
+42-75`` (createExchange / sink / source instance handles) and its
+filesystem implementation ``plugin/trino-exchange-filesystem/.../
+FileSystemExchangeManager.java`` — the substrate of fault-tolerant
+execution (RetryPolicy.TASK): a stage writes its partitioned output to
+durable storage, so a downstream task failure (or the producing worker
+dying) replays from the spool instead of re-running the producer stage.
+
+TPU-first notes: the spooled payload is the engine's wire serde frames
+(exec/serde.py) — the same dtype-tagged columnar buffers the streaming
+exchange ships, so spooling adds no extra encode step beyond framing.
+Layout: ``{base}/{exchange_id}/p{partition}.t{task}.bin`` — one file per
+(producing task, partition), length-prefixed frames, fsync'd before the
+task reports success (write-then-rename for atomicity).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tempfile
+from typing import List, Optional
+
+from ..exec.serde import PageDeserializer, PageSerializer
+
+
+class ExchangeSink:
+    """One producing task's durable writer (reference:
+    spi/exchange/ExchangeSink.java): add pages per partition, finish()
+    atomically publishes every partition file."""
+
+    def __init__(self, directory: str, task: int, n_partitions: int):
+        self.directory = directory
+        self.task = task
+        self._sers = [PageSerializer() for _ in range(n_partitions)]
+        self._tmp: List[Optional[object]] = []
+        os.makedirs(directory, exist_ok=True)
+        for p in range(n_partitions):
+            f = tempfile.NamedTemporaryFile(
+                dir=directory, prefix=f".p{p}.t{task}.", delete=False)
+            self._tmp.append(f)
+
+    def add(self, partition: int, page):
+        frame = self._sers[partition].serialize(page)
+        f = self._tmp[partition]
+        f.write(struct.pack("<I", len(frame)))
+        f.write(frame)
+
+    def finish(self):
+        """Publish atomically: fsync then rename into the final name —
+        a half-written spool must never be readable under it."""
+        for p, f in enumerate(self._tmp):
+            f.flush()
+            os.fsync(f.fileno())
+            f.close()
+            os.rename(f.name, os.path.join(
+                self.directory, f"p{p}.t{self.task}.bin"))
+
+    def abort(self):
+        for f in self._tmp:
+            try:
+                f.close()
+                os.unlink(f.name)
+            except OSError:
+                pass
+
+
+def read_spool(directory: str, partition: int) -> List:
+    """Exchange source: all producing tasks' pages for one partition
+    (reference: spi/exchange/ExchangeSource.java)."""
+    pages: List = []
+    if not os.path.isdir(directory):
+        raise FileNotFoundError(f"spool directory missing: {directory}")
+    names = sorted(n for n in os.listdir(directory)
+                   if n.startswith(f"p{partition}.t")
+                   and n.endswith(".bin"))
+    for name in names:
+        de = PageDeserializer()  # one stream per producing task file
+        with open(os.path.join(directory, name), "rb") as f:
+            while True:
+                head = f.read(4)
+                if not head:
+                    break
+                (n,) = struct.unpack("<I", head)
+                pages.append(de.deserialize(f.read(n)))
+    return pages
+
+
+class FileSystemExchangeManager:
+    """Creates/locates spooled exchanges under one base directory
+    (reference: FileSystemExchangeManager — base URI + per-exchange
+    subdirectories). The coordinator owns the lifecycle: one exchange
+    per (query, fragment), removed when the query releases."""
+
+    def __init__(self, base_dir: Optional[str] = None):
+        self.base_dir = base_dir or tempfile.mkdtemp(
+            prefix="trino_tpu_spool_")
+
+    def exchange_dir(self, query_id: str, fragment_id: int) -> str:
+        return os.path.join(self.base_dir, f"{query_id}.f{fragment_id}")
+
+    def create_sink(self, query_id: str, fragment_id: int, task: int,
+                    n_partitions: int) -> ExchangeSink:
+        return ExchangeSink(self.exchange_dir(query_id, fragment_id),
+                            task, n_partitions)
+
+    def remove_exchange(self, query_id: str, fragment_id: int):
+        import shutil
+
+        shutil.rmtree(self.exchange_dir(query_id, fragment_id),
+                      ignore_errors=True)
+
+    def remove_all(self):
+        import shutil
+
+        shutil.rmtree(self.base_dir, ignore_errors=True)

@@ -9,15 +9,34 @@ in-process mesh path; the process runtime exists to exercise the real
 coordinator/worker architecture: RPC, serde, pull-based shuffle,
 failure handling).
 
+Two execution modes, selected per task by the coordinator:
+- streaming (default): ``run_task`` returns immediately, the task runs
+  in a background thread against a BOUNDED output buffer, consumers
+  long-poll ``get_page_stream`` incrementally, and upstream reads go
+  through RemoteExchangeChannels — all stages of a query run
+  concurrently across processes (reference:
+  execution/scheduler/PipelinedQueryScheduler.java:155);
+- barrier: ``run_task`` blocks until the task finished and buffered its
+  whole output; consumers pull the snapshot with ``get_results`` (the
+  fault-tolerant shape: outputs survive for task retry).
+
 Protocol (rpc.py framing; one request per connection):
-  configure     {catalogs, properties}            -> {ok}
-  run_task      {task_id, fragment, task_index, task_count,
-                 output_kind, n_partitions, upstream, session,
-                 inject_failure?}                 -> {ok|error, rows}
-  get_results   {task_id, partition}              -> header + page frames
-  release_task  {task_id}                         -> {ok}
-  ping          {}                                -> {ok, tasks}
-  shutdown      {}                                -> {ok} (then exits)
+  configure       {catalogs, properties}            -> {ok}
+  run_task        {task_id, fragment, task_index, task_count,
+                   output_kind, n_partitions, upstream, session,
+                   streaming?, buffer_bound?, coordinator?,
+                   remote_write_catalogs?, inject_failure?}
+                                                    -> {ok|error, rows?}
+  get_results     {task_id, partition}              -> header + frames
+  get_page_stream {task_id, partition, consumer_id, wait}
+                                                    -> header + frames
+  task_status     {task_ids}                        -> {statuses}
+  abort_task      {task_id}                         -> {ok}
+  sync_table      {catalog, schema, table, columns, frames} -> {ok}
+  drop_table      {catalog, schema, table}          -> {ok}
+  release_task    {task_id}                         -> {ok}
+  ping            {}                                -> {ok, tasks}
+  shutdown        {}                                -> {ok} (then exits)
 """
 
 from __future__ import annotations
@@ -26,6 +45,7 @@ import os
 import socketserver
 import sys
 import threading
+import time
 import traceback
 from typing import Dict, List
 
@@ -38,6 +58,10 @@ class _TaskState:
         self.error = None
         self.buffer = None          # ops.output.OutputBuffer
         self.rows = 0
+        self.abort = threading.Event()
+        self.serializers: Dict[tuple, object] = {}
+        self.channels: List = []    # RemoteExchangeChannels to close
+        self.thread = None
 
 
 class WorkerServer:
@@ -84,7 +108,26 @@ class WorkerServer:
             send_msg(sock, self.run_task(req))
         elif op == "get_results":
             self.send_results(sock, req["task_id"], req["partition"])
+        elif op == "get_page_stream":
+            self.stream_results(sock, req)
+        elif op == "task_status":
+            send_msg(sock, {"statuses": self.task_statuses(
+                req.get("task_ids"))})
+        elif op == "abort_task":
+            self._abort_task(req["task_id"])
+            send_msg(sock, {"ok": True})
+        elif op == "sync_table":
+            send_msg(sock, self.sync_table(req))
+        elif op == "drop_table":
+            conn = self.connectors.get(req["catalog"])
+            if conn is not None:
+                h = conn.metadata().get_table_handle(req["schema"],
+                                                     req["table"])
+                if h is not None:
+                    conn.metadata().drop_table(h)
+            send_msg(sock, {"ok": True})
         elif op == "release_task":
+            self._abort_task(req["task_id"])
             with self._lock:
                 self.tasks.pop(req["task_id"], None)
             send_msg(sock, {"ok": True})
@@ -98,35 +141,174 @@ class WorkerServer:
         else:
             send_msg(sock, {"error": f"unknown op {op!r}"})
 
+    def _abort_task(self, task_id: str):
+        with self._lock:
+            state = self.tasks.get(task_id)
+        if state is not None:
+            state.abort.set()
+            if state.buffer is not None:
+                state.buffer.abort()
+            for ch in state.channels:
+                ch.close()
+
+    def task_statuses(self, task_ids) -> dict:
+        out = {}
+        with self._lock:
+            items = [(tid, self.tasks.get(tid)) for tid in task_ids] \
+                if task_ids is not None else list(self.tasks.items())
+        for tid, state in items:
+            if state is None:
+                out[tid] = {"status": "missing"}
+            else:
+                out[tid] = {
+                    "status": state.status, "error": state.error,
+                    "rows": state.rows,
+                    "overlapped": (state.buffer.overlapped
+                                   if state.buffer is not None and
+                                   hasattr(state.buffer, "overlapped")
+                                   else False)}
+        return out
+
+    def sync_table(self, req: dict) -> dict:
+        """Bring the local replica of a memory-catalog table up to the
+        coordinator's committed state (replicated storage: every worker
+        scans its own full copy). ``start`` is the coordinator's
+        replication cursor: pages [start:] are appended when the local
+        replica matches it, start=0 replaces wholesale; a mismatch asks
+        the coordinator for a full resync."""
+        from ..exec.serde import PageDeserializer
+
+        conn = self.connectors.get(req["catalog"])
+        if conn is None:
+            return {"error": f"no catalog {req['catalog']!r}"}
+        md = conn.metadata()
+        schema, table = req["schema"], req["table"]
+        handle = md.get_table_handle(schema, table)
+        if handle is None:
+            md.create_table(schema, table, req["columns"])
+        data = conn.tables[(schema, table)]
+        start = int(req.get("start", 0))
+        de = PageDeserializer()
+        pages = [data.canonicalize(de.deserialize(f))
+                 for f in req.get("frames", [])]
+        with data.lock:
+            if start == 0:
+                data.pages = pages
+            elif start == len(data.pages):
+                data.pages.extend(pages)
+            else:
+                return {"resync": True, "have": len(data.pages)}
+            total = len(data.pages)
+        return {"ok": True, "pages": total}
+
     # ------------------------------------------------------------------
 
     def run_task(self, req: dict) -> dict:
+        from ..ops.output import OutputBuffer
+
         task_id = req["task_id"]
         state = _TaskState()
         with self._lock:
             self.tasks[task_id] = state
+        if not req.get("streaming"):
+            try:
+                if req.get("inject_failure"):
+                    raise RuntimeError(
+                        f"injected failure for task {task_id}")
+                state.rows = self._execute_fragment(req, state)
+                state.status = "finished"
+                return {"ok": True, "rows": state.rows}
+            except Exception as e:
+                state.status = "failed"
+                state.error = repr(e)
+                traceback.print_exc()
+                return {"error": state.error, "task_id": task_id}
+        # streaming: the buffer must exist before we acknowledge, so
+        # consumers can start pulling immediately
+        frag = req["fragment"]
+        state.buffer = OutputBuffer(
+            1 if frag.output_kind == "single" else req["n_partitions"],
+            broadcast=frag.output_kind == "broadcast",
+            max_pending_pages=req.get("buffer_bound"))
+        state.thread = threading.Thread(
+            target=self._run_streaming, args=(req, state), daemon=True)
+        state.thread.start()
+        return {"ok": True, "started": True}
+
+    def _run_streaming(self, req: dict, state: _TaskState):
+        from .remote_exchange import ExchangeConnectionLost
+
         try:
             if req.get("inject_failure"):
                 # reference: execution/FailureInjector.java:40 — typed
                 # error injected at task execution for FT tests
                 raise RuntimeError(
-                    f"injected failure for task {task_id}")
-            state.rows = self._execute_fragment(req, state)
+                    f"injected failure for task {req['task_id']}")
+            state.rows = self._execute_fragment(req, state,
+                                                streaming=True)
             state.status = "finished"
-            return {"ok": True, "rows": state.rows}
-        except Exception as e:
+            state.buffer.set_no_more_pages()
+        except ExchangeConnectionLost as e:
+            state.error = f"[connection-lost] {e!r}"
             state.status = "failed"
+            state.buffer.abort()
+        except Exception as e:
             state.error = repr(e)
-            traceback.print_exc()
-            return {"error": state.error, "task_id": task_id}
+            state.status = "failed"
+            if not state.abort.is_set():
+                traceback.print_exc()
+            state.buffer.abort()
+        finally:
+            for ch in state.channels:
+                ch.close()
 
-    def _execute_fragment(self, req: dict, state: _TaskState) -> int:
+    def _sink_factory(self, req: dict):
+        """Write-sink resolution for worker-side TableWriter tasks:
+        coordinator-owned catalogs (memory) write through the page-sink
+        RPC; everything else uses the local connector sink."""
+        remote_catalogs = set(req.get("remote_write_catalogs") or ())
+        coordinator = req.get("coordinator")
+
+        def factory(node):
+            from ..exec.local_planner import create_table_idempotent
+            from .remote_exchange import RemotePageSink
+            from .rpc import call
+
+            conn = self.connectors[node.catalog]
+            if coordinator and node.catalog in remote_catalogs:
+                if node.create:
+                    resp = call(tuple(coordinator), {
+                        "op": "create_table", "catalog": node.catalog,
+                        "schema": node.schema, "table": node.table_name,
+                        "columns": node.columns})
+                    if not resp.get("ok"):
+                        raise RuntimeError(
+                            f"coordinator create_table failed: "
+                            f"{resp.get('error')}")
+                return RemotePageSink(tuple(coordinator), node.catalog,
+                                      node.schema, node.table_name,
+                                      task_id=req["task_id"])
+            if node.create:
+                handle = create_table_idempotent(
+                    conn, node.schema, node.table_name, node.columns)
+            else:
+                handle = conn.metadata().get_table_handle(
+                    node.schema, node.table_name)
+            return conn.page_sink(handle, node.columns)
+
+        return factory
+
+    def _execute_fragment(self, req: dict, state: _TaskState,
+                          streaming: bool = False) -> int:
         from ..exec.driver import Driver
         from ..exec.local_planner import (LocalExecutionPlanner,
-                                          PhysicalPipeline)
+                                          PhysicalPipeline,
+                                          project_to_wire_layout)
         from ..exec.serde import PageDeserializer
         from ..ops.output import OutputBuffer, PartitionedOutputOperator
         from ..planner.logical_planner import Metadata
+        from .remote_exchange import (RemoteExchangeChannel,
+                                      run_driver_blocking)
         from .rpc import fetch_pages
 
         frag = req["fragment"]
@@ -137,6 +319,17 @@ class WorkerServer:
             src = upstream[fragment_id]
             part = 0 if src["kind"] in ("single", "broadcast") \
                 else task_index
+            if src.get("spool_dir"):
+                # fault-tolerant mode: inputs replay from the durable
+                # spool — the producing worker may be gone
+                from .spool import read_spool
+
+                return lambda: read_spool(src["spool_dir"], part)
+            if streaming:
+                chan = RemoteExchangeChannel(
+                    src["locations"], part, consumer_id=task_index)
+                state.channels.append(chan)
+                return chan
 
             def thunk():
                 pages: List = []
@@ -156,21 +349,46 @@ class WorkerServer:
             exchange_reader=exchange_reader,
             join_max_lanes=session_props.get("join_max_expand_lanes"),
             dynamic_filtering=session_props.get(
-                "enable_dynamic_filtering", True))
-        from ..exec.local_planner import project_to_wire_layout
+                "enable_dynamic_filtering", True),
+            page_sink_factory=self._sink_factory(req))
 
         ops, layout, types_ = planner.visit(frag.root)
         ops, layout, types_, key_channels = project_to_wire_layout(
             frag, ops, layout, types_)
-        buffer = OutputBuffer(
-            1 if frag.output_kind == "single" else req["n_partitions"],
-            broadcast=frag.output_kind == "broadcast")
+        if streaming:
+            buffer = state.buffer  # pre-created by run_task
+        else:
+            buffer = OutputBuffer(
+                1 if frag.output_kind == "single"
+                else req["n_partitions"],
+                broadcast=frag.output_kind == "broadcast")
+            state.buffer = buffer
         ops.append(PartitionedOutputOperator(types_, key_channels, buffer,
                                              frag.output_kind))
         planner.pipelines.append(PhysicalPipeline(ops))
         for p in planner.pipelines:
-            Driver(p.operators).run_to_completion()
-        state.buffer = buffer
+            if streaming:
+                run_driver_blocking(Driver(p.operators), state.abort)
+            else:
+                Driver(p.operators).run_to_completion()
+        spool_dir = req.get("spool_dir")
+        if spool_dir:
+            # durable publish BEFORE reporting success: a retried
+            # consumer must find the complete output on disk even if
+            # this process dies right after responding
+            from .spool import ExchangeSink
+
+            nparts = 1 if frag.output_kind in ("single", "broadcast") \
+                else req["n_partitions"]
+            sink = ExchangeSink(spool_dir, task_index, nparts)
+            try:
+                for part in range(nparts):
+                    for page in buffer.pages(part):
+                        sink.add(part, page)
+                sink.finish()
+            except BaseException:
+                sink.abort()
+                raise
         return buffer.total_rows
 
     # ------------------------------------------------------------------
@@ -189,6 +407,52 @@ class WorkerServer:
         ser = PageSerializer()
         for p in pages:
             send_frame(sock, ser.serialize(p))
+
+    def stream_results(self, sock, req: dict):
+        """Incremental long-poll pull of one consumer's partition
+        (reference: TaskResource GET results with ack token — the drain
+        cursor in OutputBuffer.poll is the ack)."""
+        from ..exec.serde import PageSerializer
+        from ..ops.output import wait_readable
+
+        task_id = req["task_id"]
+        partition = req["partition"]
+        consumer = req.get("consumer_id", 0)
+        deadline = time.monotonic() + float(req.get("wait", 0.5))
+        with self._lock:
+            state = self.tasks.get(task_id)
+        if state is None or state.buffer is None:
+            send_msg(sock, {"error": f"task {task_id} missing",
+                            "connection_lost": True})
+            return
+        buf = state.buffer
+        frames: List[bytes] = []
+        ser = state.serializers.setdefault((partition, consumer),
+                                           PageSerializer())
+        while True:
+            while len(frames) < 64:
+                p = buf.poll(partition, consumer)
+                if p is None:
+                    break
+                frames.append(ser.serialize(p))
+            done = buf.at_end(partition, consumer)
+            # status AFTER at_end: abort() follows the status write, so
+            # an at_end that observed the aborted (emptied) buffer is
+            # guaranteed to see status=="failed" here — a done=True
+            # reply must never paper over a failure as clean EOS
+            if state.status == "failed":
+                send_msg(sock, {
+                    "error": state.error or "task failed",
+                    "connection_lost": "[connection-lost]"
+                    in (state.error or "")})
+                return
+            if frames or done or time.monotonic() >= deadline:
+                break
+            wait_readable(buf, timeout=min(
+                0.25, max(0.0, deadline - time.monotonic())))
+        send_msg(sock, {"n_pages": len(frames), "done": done})
+        for f in frames:
+            send_frame(sock, f)
 
     def serve_forever(self):
         self.server.serve_forever()

@@ -85,6 +85,12 @@ register(SessionProperty(
     "before the producing pipeline stalls",
     lambda v: v >= 1))
 register(SessionProperty(
+    "retry_policy", "string", "QUERY",
+    "Failure recovery for the multi-process runtime: NONE (fail), "
+    "QUERY (re-run the query), TASK (durable spooled exchange; failed "
+    "tasks retry from spool WITHOUT re-running producer stages)",
+    lambda v: v in ("NONE", "QUERY", "TASK")))
+register(SessionProperty(
     "device_exchange", "boolean", True,
     "Run hash exchanges between co-resident stages as an all_to_all "
     "device collective over the mesh (falls back to the host path when "
