@@ -1,0 +1,139 @@
+"""Sketch aggregates: HLL approx_distinct + DDSketch approx_percentile.
+
+Reference analog: TestApproximateCountDistinct / TestApproxPercentile —
+error-bounded estimates, mergeability across partial/final steps and
+exchanges (the rewrite lowers sketches onto ordinary distributed
+group-bys, so distribution MUST NOT change the answer), NULL handling.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.block import Block, Page
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.parallel.distributed import DistributedQueryRunner
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+
+
+@pytest.fixture(scope="module")
+def tpch():
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=2048)},
+                            Session(catalog="tpch", schema="micro"))
+
+
+def test_error_bound_at_1m_distincts():
+    """m=2048 registers -> standard error ~2.3%; 1M distinct values
+    must estimate within 3 sigma."""
+    mem = MemoryConnector()
+    r = LocalQueryRunner({"mem": mem},
+                         Session(catalog="mem", schema="default"))
+    r.execute("create table big (x bigint)")
+    data = mem.tables[("default", "big")]
+    n = 1_000_000
+    for lo in range(0, n, 250_000):
+        vals = np.arange(lo, lo + 250_000, dtype=np.int64)
+        data.pages.append(Page([Block(T.BIGINT, vals)], len(vals)))
+    [(est,)] = r.execute("select approx_distinct(x) from big").rows
+    assert abs(est - n) / n < 0.07, est
+    # repeated values don't inflate the estimate
+    [(est2,)] = r.execute(
+        "select approx_distinct(x % 1000) from big").rows
+    assert abs(est2 - 1000) / 1000 < 0.10, est2
+
+
+def test_estimates_close_to_exact(tpch):
+    pairs = [
+        ("approx_distinct(l_orderkey)", "count(distinct l_orderkey)"),
+        ("approx_distinct(l_partkey)", "count(distinct l_partkey)"),
+        ("approx_distinct(l_shipmode)", "count(distinct l_shipmode)"),
+    ]
+    for approx, exact in pairs:
+        [(a,)] = tpch.execute(f"select {approx} from lineitem").rows
+        [(e,)] = tpch.execute(f"select {exact} from lineitem").rows
+        assert abs(a - e) <= max(3, 0.1 * e), (approx, a, e)
+
+
+def test_small_cardinalities_near_exact(tpch):
+    """Small-range correction: tiny cardinalities estimate exactly."""
+    [(a,)] = tpch.execute(
+        "select approx_distinct(l_returnflag) from lineitem").rows
+    assert a == 3
+    [(b,)] = tpch.execute(
+        "select approx_distinct(n_regionkey) from nation").rows
+    assert b == 5
+
+
+def test_merges_identically_across_exchange(tpch):
+    """The defining mergeability property: partial/final split and hash
+    exchanges must not change the estimate AT ALL (register max is
+    order- and partition-independent)."""
+    sql = ("select l_returnflag, approx_distinct(l_suppkey) "
+           "from lineitem group by l_returnflag")
+    local = sorted(tpch.execute(sql).rows)
+    dist = DistributedQueryRunner(
+        {"tpch": TpchConnector(page_rows=2048)},
+        Session(catalog="tpch", schema="micro"), n_workers=3,
+        desired_splits=8)
+    assert sorted(dist.execute(sql).rows) == local
+
+
+def test_nulls_and_mixing(tpch):
+    rows = tpch.execute(
+        "select approx_distinct(cast(null as bigint)), count(*) "
+        "from orders").rows
+    assert rows == [(0, 1500)]
+    # combines with decomposable aggregates in one grouping
+    rows = tpch.execute(
+        "select l_linestatus, approx_distinct(l_orderkey), count(*), "
+        "sum(l_quantity), max(l_shipdate) from lineitem "
+        "group by l_linestatus order by 1").rows
+    exact = tpch.execute(
+        "select l_linestatus, count(distinct l_orderkey), count(*), "
+        "sum(l_quantity), max(l_shipdate) from lineitem "
+        "group by l_linestatus order by 1").rows
+    for got, exp in zip(rows, exact):
+        assert got[0] == exp[0] and got[2:] == exp[2:]
+        assert abs(got[1] - exp[1]) <= 0.1 * exp[1]
+
+
+def test_percentile_relative_error(tpch):
+    """DDSketch contract: ~1% RELATIVE error at any percentile."""
+    for p in (0.1, 0.5, 0.9, 0.99):
+        [(a,)] = tpch.execute(
+            f"select approx_percentile(l_extendedprice, {p}) "
+            "from lineitem").rows
+        # exact percentile via sorted offset
+        [(n,)] = tpch.execute(
+            "select count(*) from lineitem").rows
+        k = max(0, int(np.ceil(p * n)) - 1)
+        [(e,)] = tpch.execute(
+            "select l_extendedprice from lineitem "
+            f"order by l_extendedprice offset {k} limit 1").rows
+        assert abs(float(a) - float(e)) / float(e) < 0.015, (p, a, e)
+
+
+def test_percentile_grouped_and_typed(tpch):
+    rows = tpch.execute(
+        "select l_returnflag, approx_percentile(l_quantity, 0.5) "
+        "from lineitem group by l_returnflag order by 1").rows
+    assert len(rows) == 3
+    for _, v in rows:
+        assert 20 <= float(v) <= 30  # quantity uniform 1..50
+    # integer argument returns an integer
+    [(v,)] = tpch.execute(
+        "select approx_percentile(o_custkey, 0.5) from orders").rows
+    assert isinstance(v, int)
+
+
+def test_percentile_validation(tpch):
+    from trino_tpu.sql.analyzer import AnalysisError
+
+    with pytest.raises(AnalysisError):
+        tpch.execute("select approx_percentile(l_quantity, 1.5) "
+                     "from lineitem")
+    with pytest.raises(AnalysisError):
+        tpch.execute("select approx_percentile(l_quantity, o_orderkey) "
+                     "from lineitem, orders")

@@ -1204,3 +1204,146 @@ register(ScalarFunction("map_keys", _resolve_map_keys,
                         str_transform=lambda m: tuple(k for k, _ in m)))
 register(ScalarFunction("map_values", _resolve_map_values,
                         str_transform=lambda m: tuple(v for _, v in m)))
+
+
+# ---------------------------------------------------------------------------
+# sketch primitives: HLL (approx_distinct) + DDSketch (approx_percentile)
+#
+# Reference analog: ``spi/type/setdigest/`` + ``operator/aggregation/``'s
+# HyperLogLog state and ``airlift/stats`` digests. TPU-first redesign:
+# sketches are not opaque binary accumulator states — the logical planner
+# rewrites the aggregate onto RELATIONAL algebra over these row-level
+# primitives (register id / rank for HLL, log-bucket for DDSketch), so
+# partial/final merging and exchange transport reuse the engine's
+# ordinary distributed group-by kernels (planner/logical_planner.py
+# _plan_sketch_aggs).
+
+HLL_BITS = 11            #: m = 2048 registers -> standard error ~2.3%
+HLL_M = 1 << HLL_BITS
+HLL_ALPHA = 0.7213 / (1.0 + 1.079 / HLL_M)
+
+DD_GAMMA = 1.0202027073175195   #: relative accuracy alpha = 0.01
+DD_OFFSET = 40000               #: keeps positive-value buckets positive
+
+
+def _splitmix64_dev(k):
+    z = k + np.uint64(0x9E3779B97F4A7C15)
+    z = (z ^ (z >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    z = (z ^ (z >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return z ^ (z >> np.uint64(31))
+
+
+def _hash_u64_dev(raw, t):
+    """Device value hash. Floats use a COLLISION-FREE bit encoding —
+    the exchange path's *65536 quantization is fine for routing (a
+    collision only skews partitioning) but would merge distinct values
+    in a cardinality sketch. ``+0.0`` normalizes -0.0; the f64 bitcast
+    runs only where f64 exists (CPU x64 — on TPU the storage is f32)."""
+    import jax
+
+    if t in (T.DOUBLE, T.REAL):
+        x = raw + 0.0  # -0.0 -> +0.0
+        if x.dtype == jnp.float64:
+            k = jax.lax.bitcast_convert_type(x, jnp.uint64)
+        else:
+            k = jax.lax.bitcast_convert_type(
+                x.astype(jnp.float32), jnp.uint32).astype(jnp.uint64)
+    elif t == T.BOOLEAN:
+        k = raw.astype(jnp.uint64)
+    else:
+        k = raw.astype(jnp.int64).view(jnp.uint64)
+    return _splitmix64_dev(k)
+
+
+def _hash_u64_host(v) -> int:
+    """Host value hash for pooled (string/composite) arguments — any
+    stable 64-bit digest works; bucket/rho only need consistency."""
+    import hashlib
+
+    digest = hashlib.blake2b(repr(v).encode(), digest_size=8).digest()
+    return int.from_bytes(digest, "little")
+
+
+def _resolve_sketchable(name):
+    def resolve(args):
+        (a,) = args
+        if a == T.UNKNOWN:
+            raise TypeError_(f"{name}() cannot hash NULL-typed input")
+        return T.BIGINT
+
+    return resolve
+
+
+def _hll_bucket_kernel(raws, arg_types, ret_type):
+    h = _hash_u64_dev(raws[0], arg_types[0])
+    return (h & np.uint64(HLL_M - 1)).astype(jnp.int64)
+
+
+def _bit_length_u64(v):
+    """Vectorized bit_length by halving (exact, no float log)."""
+    bl = jnp.zeros(v.shape, dtype=jnp.int64)
+    x = v
+    for s in (32, 16, 8, 4, 2, 1):
+        m = x >= (np.uint64(1) << np.uint64(s))
+        bl = bl + jnp.where(m, s, 0)
+        x = jnp.where(m, x >> np.uint64(s), x)
+    return bl + x.astype(jnp.int64)
+
+
+def _hll_rho_kernel(raws, arg_types, ret_type):
+    h = _hash_u64_dev(raws[0], arg_types[0])
+    rest = h >> np.uint64(HLL_BITS)          # 53 remaining bits
+    return (53 - _bit_length_u64(rest) + 1).astype(jnp.int64)
+
+
+def _hll_bucket_host(v):
+    return _hash_u64_host(v) & (HLL_M - 1)
+
+
+def _hll_rho_host(v):
+    rest = _hash_u64_host(v) >> HLL_BITS
+    return 53 - rest.bit_length() + 1
+
+
+register(ScalarFunction("$hll_bucket", _resolve_sketchable("$hll_bucket"),
+                        _hll_bucket_kernel, str_scalar=_hll_bucket_host))
+register(ScalarFunction("$hll_rho", _resolve_sketchable("$hll_rho"),
+                        _hll_rho_kernel, str_scalar=_hll_rho_host))
+
+
+def _resolve_dd_bucket(args):
+    (a,) = args
+    if not is_numeric(a):
+        raise TypeError_(f"approx_percentile expects numeric, got {a}")
+    return T.BIGINT
+
+
+def _dd_bucket_kernel(raws, arg_types, ret_type):
+    t = arg_types[0]
+    x = jnp.asarray(raws[0], jnp.float64)
+    if t.is_decimal:
+        x = x / float(10 ** t.scale)
+    mag = jnp.abs(x)
+    lg = jnp.log(jnp.maximum(mag, 1e-300)) / math.log(DD_GAMMA)
+    b = jnp.ceil(lg).astype(jnp.int64) + DD_OFFSET
+    return jnp.where(mag < 1e-300, 0,
+                     jnp.where(x > 0, b, -b)).astype(jnp.int64)
+
+
+register(ScalarFunction("$dd_bucket", _resolve_dd_bucket,
+                        _dd_bucket_kernel))
+
+
+def _resolve_dd_value(args):
+    return T.DOUBLE
+
+
+def _dd_value_kernel(raws, arg_types, ret_type):
+    b = raws[0]
+    mag = jnp.abs(b).astype(jnp.float64) - DD_OFFSET
+    # geometric midpoint of the bucket (gamma^(b-1), gamma^b]
+    val = jnp.exp((mag - 0.5) * math.log(DD_GAMMA))
+    return jnp.where(b == 0, 0.0, jnp.where(b > 0, val, -val))
+
+
+register(ScalarFunction("$dd_value", _resolve_dd_value, _dd_value_kernel))

@@ -81,6 +81,19 @@ def resolve_agg_type(function: str, arg_type: Optional[T.Type]) -> T.Type:
         if arg_type != T.BOOLEAN:
             raise TypeError_(f"count_if expects boolean, got {arg_type}")
         return T.BIGINT
+    if function == "approx_distinct":
+        return T.BIGINT
+    if function == "approx_percentile":
+        # same-type contract as the reference; the sketch rewrite
+        # rounds back for integers (logical_planner._plan_dd_percentile)
+        if arg_type in (T.TINYINT, T.SMALLINT, T.INTEGER, T.BIGINT):
+            return T.BIGINT
+        if arg_type in (T.REAL, T.DOUBLE):
+            return T.DOUBLE
+        if arg_type.is_decimal:
+            return arg_type
+        raise TypeError_(
+            f"approx_percentile does not support {arg_type} yet")
     raise TypeError_(f"unknown aggregate function {function}")
 
 

@@ -38,7 +38,7 @@ AGGREGATE_FUNCTIONS = {
     "count", "sum", "avg", "min", "max", "stddev", "stddev_samp",
     "stddev_pop", "variance", "var_samp", "var_pop", "count_if",
     "bool_and", "bool_or", "every", "arbitrary", "any_value",
-    "approx_distinct", "geometric_mean",
+    "approx_distinct", "approx_percentile", "geometric_mean",
 }
 
 _COMPARISON_FN = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
