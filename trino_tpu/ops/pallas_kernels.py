@@ -125,9 +125,13 @@ def _kernel(starts_ref, col_ref, gid_ref, out_ref, *, kind: str,
             oh = onehot.astype(jnp.float32)
 
             def dot(v):
+                # HIGHEST precision: the default lowers f32 MXU matmuls
+                # to bf16 passes whose 8-bit mantissa would round the
+                # 12-bit parts — the exactness argument needs true f32
                 return jax.lax.dot_general(
                     v[None, :], oh, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32)[0]
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)[0]
 
             lo_s = dot((col & 0xFFF).astype(jnp.float32))
             mid_s = dot(((col >> 12) & 0xFFF).astype(jnp.float32))
@@ -139,7 +143,8 @@ def _kernel(starts_ref, col_ref, gid_ref, out_ref, *, kind: str,
             oh = onehot.astype(jnp.float32)
             win = jax.lax.dot_general(
                 col[None, :], oh, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)[0]
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST)[0]
         upd = out_ref[0, pl.dslice(start, _WIN)] + win
     else:
         contrib = jnp.where(onehot, col[:, None],
