@@ -1,0 +1,136 @@
+"""Grouped top-N ranking + order-preserving merge exchange.
+
+Reference analog: ``operator/GroupedTopNBuilder.java`` /
+``TopNRankingOperator.java`` (per-group truncation under row_number/
+rank) and ``operator/MergeOperator.java`` + LocalMergeSourceOperator
+(distributed ORDER BY gathers pre-sorted runs and merges — no full
+re-sort).
+"""
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.parallel.distributed import DistributedQueryRunner
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+
+RANKING_SQL = (
+    "select * from (select c_nationkey, c_name, c_acctbal, "
+    "row_number() over (partition by c_nationkey "
+    "order by c_acctbal desc, c_custkey) rn from customer) "
+    "where rn <= 2 order by c_nationkey, rn")
+
+
+@pytest.fixture(scope="module")
+def conn():
+    return TpchConnector(page_rows=2048)
+
+
+@pytest.fixture(scope="module")
+def local(conn):
+    return LocalQueryRunner({"tpch": conn},
+                            Session(catalog="tpch", schema="micro"))
+
+
+@pytest.fixture(scope="module")
+def dist(conn):
+    return DistributedQueryRunner({"tpch": conn},
+                                  Session(catalog="tpch",
+                                          schema="micro"),
+                                  n_workers=3, desired_splits=8,
+                                  broadcast_threshold=300.0)
+
+
+def test_ranking_query_plans_to_grouped_topn(local):
+    """The round-3/4 carried 'done' criterion: a bounded ranking window
+    PLANS to TopNRanking (EXPLAIN assert) instead of materializing
+    whole window partitions."""
+    plan = local.explain(RANKING_SQL)
+    assert "TopNRanking" in plan
+    assert "FilterOverWindowToTopNRanking" in plan
+    # the window node itself is gone
+    assert "- Window" not in plan
+
+
+def test_grouped_topn_distributed_partial_final(dist):
+    """Distributed plan: partial truncation BEFORE the hash exchange
+    (at most groups*N rows cross the wire), final re-rank after."""
+    plan = dist.explain(RANKING_SQL)
+    assert "TopNRanking [partial]" in plan
+    assert "TopNRanking [final]" in plan
+    before, after = plan.split("Fragment 1")[0], \
+        plan.split("Fragment 1")[1]
+    assert "TopNRanking [partial]" in before
+
+
+def test_grouped_topn_rows_match_window(local, dist):
+    lrows = local.execute(RANKING_SQL).rows
+    drows = dist.execute(RANKING_SQL).rows
+    assert lrows == drows
+    assert len(lrows) == 50  # 25 nations x top-2
+    # cross-check against the unrewritten window semantics: every
+    # nation's rows are its 2 largest balances
+    full = local.execute(
+        "select c_nationkey, c_acctbal from customer").rows
+    by_nation = {}
+    for k, bal in full:
+        by_nation.setdefault(k, []).append(bal)
+    for k, _name, bal, rn in lrows:
+        top2 = sorted(by_nation[k], reverse=True)[:2]
+        assert bal == top2[rn - 1], (k, rn, bal, top2)
+
+
+def test_rank_ties_kept(local):
+    rows = local.execute(
+        "select * from (select l_linestatus, l_quantity, "
+        "rank() over (partition by l_linestatus "
+        "order by l_quantity) rk from lineitem) where rk <= 3").rows
+    # quantity is integral: rank 1..3 covers all ties at those ranks
+    assert rows
+    for _st, q, rk in rows:
+        assert rk <= 3
+    # every linestatus keeps ALL minimal-quantity ties
+    import collections
+
+    per = collections.Counter(st for st, _q, _r in rows)
+    assert all(v >= 3 for v in per.values())
+
+
+def test_merge_exchange_plan_and_order(local, dist):
+    """Distributed ORDER BY: per-task sorts + a 'merge' gather, and NO
+    Sort node above the exchange (the round-3/4 carried criterion:
+    merge-preserving distributed sort, not gather-then-resort)."""
+    sql = ("select l_orderkey, l_extendedprice from lineitem "
+           "where l_quantity < 15 "
+           "order by l_extendedprice desc, l_orderkey")
+    plan = dist.explain(sql)
+    head, tail = plan.split("Fragment 1")
+    assert "-> merge" in head and "- Sort" in head
+    assert "- Sort" not in tail.split("Optimizer")[0]
+    lrows = local.execute(sql).rows
+    drows = dist.execute(sql).rows
+    assert drows == lrows
+
+
+def test_merge_exchange_strings_and_nulls(local, dist):
+    sql = ("select c_mktsegment, c_name from customer "
+           "order by c_mktsegment, c_name desc limit 40")
+    assert local.execute(sql).rows == dist.execute(sql).rows
+
+
+def test_grouped_topn_cross_process():
+    """The multi-process runtime takes the same plan shape."""
+    from trino_tpu.parallel.process_runner import ProcessQueryRunner
+
+    with ProcessQueryRunner(
+            {"tpch": {"connector": "tpch", "page_rows": 2048}},
+            Session(catalog="tpch", schema="micro"),
+            n_workers=2, desired_splits=4) as c:
+        rows = c.execute(RANKING_SQL).rows
+        assert len(rows) == 50
+        sql = ("select o_orderkey, o_totalprice from orders "
+               "order by o_totalprice desc limit 20")
+        lr = LocalQueryRunner(
+            {"tpch": TpchConnector(page_rows=2048)},
+            Session(catalog="tpch", schema="micro"))
+        assert c.execute(sql).rows == lr.execute(sql).rows

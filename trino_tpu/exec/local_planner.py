@@ -409,6 +409,21 @@ class LocalExecutionPlanner:
         layout = {s.name: i for i, s in enumerate(node.symbols)}
         return [source], layout, [s.type for s in node.symbols]
 
+    def _v_TopNRankingNode(self, node):
+        from ..ops.grouped_topn import GroupedTopNOperator
+
+        ops, layout, types_ = self.visit(node.source)
+        pchans = [layout[s.name] for s in node.partition_by]
+        keys = _sort_keys(node.orderings, layout)
+        ops.append(GroupedTopNOperator(types_, pchans, keys,
+                                       node.ranking, node.max_rank,
+                                       step=node.step))
+        if node.step == "partial":
+            return ops, layout, list(types_)
+        new_layout = dict(layout)
+        new_layout[node.rank_symbol.name] = len(types_)
+        return ops, new_layout, list(types_) + [T.BIGINT]
+
     def _v_WindowNode(self, node):
         from ..ops.window import WindowCall, WindowOperator
 
@@ -460,11 +475,20 @@ class LocalExecutionPlanner:
         assert self.exchange_reader is not None, \
             "remote source outside distributed execution"
         types_ = [s.type for s in node.symbols]
+        layout = {s.name: i for i, s in enumerate(node.symbols)}
+        if node.kind == "merge":
+            # order-preserving gather: one stream per producer task,
+            # k-way merged under the exchange's orderings
+            from ..ops.merge_exchange import MergeExchangeSourceOperator
+
+            streams = self.exchange_reader(node.fragment_id, "merge")
+            keys = _sort_keys(node.orderings or [], layout)
+            return [MergeExchangeSourceOperator(streams, types_, keys)], \
+                layout, types_
         thunk = self.exchange_reader(node.fragment_id, node.kind)
         from ..ops.output import ExchangeSourceOperator
 
         source = ExchangeSourceOperator(thunk, types_)
-        layout = {s.name: i for i, s in enumerate(node.symbols)}
         return [source], layout, types_
 
     def _v_IntersectNode(self, node: IntersectNode):

@@ -274,17 +274,20 @@ class ExchangeChannel:
 
 class PartitionedOutputOperator(Operator):
     """Routes each row of the input to an output-buffer partition.
-    kind: 'hash' (by key columns), 'single' (partition 0), 'broadcast'.
+    kind: 'hash' (by key columns), 'single' (partition 0), 'broadcast',
+    'merge' (everything to this task's OWN partition so the consumer
+    sees one sorted stream per producer).
     """
 
     def __init__(self, input_types: Sequence[T.Type],
                  key_channels: Sequence[int], buffer: OutputBuffer,
-                 kind: str = "hash"):
-        assert kind in ("hash", "single", "broadcast")
+                 kind: str = "hash", task_partition: int = 0):
+        assert kind in ("hash", "single", "broadcast", "merge")
         self.input_types = list(input_types)
         self.key_channels = list(key_channels)
         self.buffer = buffer
         self.kind = kind
+        self.task_partition = task_partition
         self._done = False
         self._lut_cache: Dict[tuple, np.ndarray] = {}
 
@@ -303,6 +306,9 @@ class PartitionedOutputOperator(Operator):
 
     def add_input(self, page: DevicePage):
         n = self.buffer.num_partitions
+        if self.kind == "merge":
+            self.buffer.enqueue(self.task_partition, page.to_page())
+            return
         if self.kind != "hash" or n == 1:
             host = page.to_page()
             self.buffer.enqueue(0, host)

@@ -200,6 +200,11 @@ class DistributedQueryRunner:
                     out = device_ex
                 elif frag.output_kind == "single":
                     out = OutputBuffer(1, max_pending_pages=max_pending)
+                elif frag.output_kind == "merge":
+                    # one partition PER PRODUCER: each task's sorted run
+                    # stays separate for the consumer's k-way merge
+                    out = OutputBuffer(ntasks,
+                                       max_pending_pages=max_pending)
                 elif frag.output_kind == "broadcast":
                     out = OutputBuffer(self.n_workers, broadcast=True)
                 else:
@@ -278,6 +283,13 @@ class DistributedQueryRunner:
                      streaming: bool = False):
         def reader(fragment_id: int, kind: str):
             buf = buffers[fragment_id]
+            if kind == "merge":
+                # per-producer sorted streams for the k-way merge
+                if streaming:
+                    return [buf.channel(p)
+                            for p in range(buf.num_partitions)]
+                return [(lambda p=p: buf.pages(p))
+                        for p in range(buf.num_partitions)]
             part = 0 if kind == "single" else task_id
             if streaming:
                 from .device_exchange import DeviceExchange
@@ -335,7 +347,8 @@ class DistributedQueryRunner:
                     types_, key_channels, out, t))
             else:
                 ops.append(PartitionedOutputOperator(
-                    types_, key_channels, out, frag.output_kind))
+                    types_, key_channels, out, frag.output_kind,
+                    task_partition=t))
             planner.pipelines.append(PhysicalPipeline(ops))
             pipelines = planner.pipelines
         for p in pipelines:
@@ -395,6 +408,8 @@ class DistributedQueryRunner:
             out = device_ex
         elif frag.output_kind == "single":
             out = OutputBuffer(1)
+        elif frag.output_kind == "merge":
+            out = OutputBuffer(ntasks)  # one partition per producer
         elif frag.output_kind == "broadcast":
             out = OutputBuffer(self.n_workers, broadcast=True)
         else:

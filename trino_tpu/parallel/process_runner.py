@@ -511,6 +511,11 @@ class ProcessQueryRunner:
 
         def exchange_reader(fragment_id: int, kind: str):
             src = locations[fragment_id]
+            if kind == "merge":  # per-producer streams for the merge
+                chans = [RemoteExchangeChannel([loc], 0, consumer_id=0)
+                         for loc in src["locations"]]
+                channels.extend(chans)
+                return chans
             chan = RemoteExchangeChannel(src["locations"], 0,
                                          consumer_id=0)
             channels.append(chan)
@@ -675,6 +680,22 @@ class ProcessQueryRunner:
         def exchange_reader(fragment_id: int, kind: str):
             src = locations[fragment_id]
             part = 0  # output stage is task 0 of 1
+            if kind == "merge":
+                if src.get("spool_dir"):
+                    from .spool import read_spool_task
+
+                    return [(lambda i=i: read_spool_task(
+                        src["spool_dir"], 0, i))
+                        for i in range(len(src["locations"]))]
+
+                def task_thunk(loc):
+                    def thunk():
+                        de = PageDeserializer()
+                        return fetch_pages(tuple(loc[0]), loc[1], 0, de)
+
+                    return thunk
+
+                return [task_thunk(loc) for loc in src["locations"]]
             if src.get("spool_dir"):
                 from .spool import read_spool
 

@@ -67,6 +67,33 @@ class ExchangeSink:
                 pass
 
 
+def _read_task_file(path: str) -> List:
+    """Decode one task's length-prefixed spool frames — THE one reader
+    of the on-disk framing (shared by the per-partition and per-task
+    sources)."""
+    pages: List = []
+    de = PageDeserializer()  # one serde stream per producing task file
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(4)
+            if not head:
+                break
+            (n,) = struct.unpack("<I", head)
+            pages.append(de.deserialize(f.read(n)))
+    return pages
+
+
+def read_spool_task(directory: str, partition: int, task: int) -> List:
+    """One producing task's spooled pages for one partition (the merge
+    exchange reads per-task streams to preserve sort runs). A missing
+    file means the producer never PUBLISHED — losing rows silently is
+    never acceptable, so raise and let retry policy decide."""
+    path = os.path.join(directory, f"p{partition}.t{task}.bin")
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"spool file missing: {path}")
+    return _read_task_file(path)
+
+
 def read_spool(directory: str, partition: int) -> List:
     """Exchange source: all producing tasks' pages for one partition
     (reference: spi/exchange/ExchangeSource.java)."""
@@ -77,14 +104,7 @@ def read_spool(directory: str, partition: int) -> List:
                    if n.startswith(f"p{partition}.t")
                    and n.endswith(".bin"))
     for name in names:
-        de = PageDeserializer()  # one stream per producing task file
-        with open(os.path.join(directory, name), "rb") as f:
-            while True:
-                head = f.read(4)
-                if not head:
-                    break
-                (n,) = struct.unpack("<I", head)
-                pages.append(de.deserialize(f.read(n)))
+        pages.extend(_read_task_file(os.path.join(directory, name)))
     return pages
 
 

@@ -227,7 +227,8 @@ class WorkerServer:
         # consumers can start pulling immediately
         frag = req["fragment"]
         state.buffer = OutputBuffer(
-            1 if frag.output_kind == "single" else req["n_partitions"],
+            1 if frag.output_kind in ("single", "merge")
+            else req["n_partitions"],
             broadcast=frag.output_kind == "broadcast",
             max_pending_pages=req.get("buffer_bound"))
         state.thread = threading.Thread(
@@ -317,6 +318,32 @@ class WorkerServer:
 
         def exchange_reader(fragment_id: int, kind: str):
             src = upstream[fragment_id]
+            if kind == "merge":
+                # one sorted stream PER PRODUCER TASK for the consumer's
+                # k-way merge (each producer buffers its run at
+                # partition 0 of its own task buffer)
+                if src.get("spool_dir"):
+                    from .spool import read_spool_task
+
+                    return [
+                        (lambda i=i: read_spool_task(
+                            src["spool_dir"], 0, i))
+                        for i in range(len(src["locations"]))]
+                if streaming:
+                    chans = [RemoteExchangeChannel([loc], 0,
+                                                   consumer_id=task_index)
+                             for loc in src["locations"]]
+                    state.channels.extend(chans)
+                    return chans
+
+                def task_thunk(loc):
+                    def thunk():
+                        de = PageDeserializer()
+                        return fetch_pages(tuple(loc[0]), loc[1], 0, de)
+
+                    return thunk
+
+                return [task_thunk(loc) for loc in src["locations"]]
             part = 0 if src["kind"] in ("single", "broadcast") \
                 else task_index
             if src.get("spool_dir"):
@@ -359,7 +386,7 @@ class WorkerServer:
             buffer = state.buffer  # pre-created by run_task
         else:
             buffer = OutputBuffer(
-                1 if frag.output_kind == "single"
+                1 if frag.output_kind in ("single", "merge")
                 else req["n_partitions"],
                 broadcast=frag.output_kind == "broadcast")
             state.buffer = buffer
@@ -378,7 +405,8 @@ class WorkerServer:
             # this process dies right after responding
             from .spool import ExchangeSink
 
-            nparts = 1 if frag.output_kind in ("single", "broadcast") \
+            nparts = 1 if frag.output_kind in ("single", "broadcast",
+                                               "merge") \
                 else req["n_partitions"]
             sink = ExchangeSink(spool_dir, task_index, nparts)
             try:

@@ -213,6 +213,34 @@ class ExchangePlanner:
         return WindowNode(src, node.partition_by, node.orderings,
                           node.functions), dist
 
+    def _v_TopNRankingNode(self, node):
+        """partial (truncate per task, bounding the exchange to
+        groups*max_rank rows) -> hash exchange on the partition keys ->
+        final re-rank (reference: the TopNRankingNode distribution in
+        AddExchanges + PushPartialTopNRankingThroughExchange)."""
+        from dataclasses import replace as _replace
+
+        from .plan import TopNRankingNode
+
+        src, dist = self.visit(node.source)
+        if dist in (SINGLE, ANY) or (
+                node.partition_by and dist == _hash(node.partition_by)):
+            return _replace(node, source=src), dist
+        partial = TopNRankingNode(src, node.partition_by,
+                                  node.orderings, node.ranking,
+                                  node.max_rank, node.rank_symbol,
+                                  step="partial")
+        if node.partition_by:
+            ex = ExchangeNode(partial, "hash", list(node.partition_by))
+            final_dist = _hash(node.partition_by)
+        else:
+            ex = ExchangeNode(partial, "single", [])
+            final_dist = SINGLE
+        final = TopNRankingNode(ex, node.partition_by, node.orderings,
+                                node.ranking, node.max_rank,
+                                node.rank_symbol, step="final")
+        return final, final_dist
+
     def _v_TopNNode(self, node: TopNNode):
         src, dist = self.visit(node.source)
         if dist in (SINGLE, ANY):
@@ -222,8 +250,18 @@ class ExchangePlanner:
         return TopNNode(ex, node.orderings, node.count), SINGLE
 
     def _v_SortNode(self, node: SortNode):
+        """Distributed ORDER BY: each task sorts its partition, the
+        merge exchange gathers the sorted runs and the consumer k-way
+        merges — no full gather-then-resort (reference:
+        operator/MergeOperator.java + LocalMergeSourceOperator and the
+        mergingExchange of AddExchanges)."""
         src, dist = self.visit(node.source)
-        return SortNode(self._to_single(src, dist), node.orderings), SINGLE
+        if dist in (SINGLE, ANY):
+            return SortNode(src, node.orderings), SINGLE
+        partial = SortNode(src, node.orderings)
+        ex = ExchangeNode(partial, "merge", [],
+                          orderings=list(node.orderings))
+        return ex, SINGLE
 
     def _v_LimitNode(self, node: LimitNode):
         src, dist = self.visit(node.source)

@@ -56,7 +56,8 @@ class Fragmenter:
                                 list(node.keys), child_inputs)
             self.fragments.append(frag)
             remote = RemoteSourceNode(frag.fragment_id,
-                                      list(node.output_symbols), node.kind)
+                                      list(node.output_symbols), node.kind,
+                                      node.orderings)
             return remote, [frag.fragment_id]
         new_sources: List[PlanNode] = []
         inputs: List[int] = []

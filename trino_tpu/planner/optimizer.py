@@ -153,6 +153,19 @@ class Optimizer:
             src = self.prune(node.sources[0], need)
             return _replace_source(node, src)
 
+        from .plan import TopNRankingNode
+
+        if isinstance(node, TopNRankingNode):
+            need = (required - {node.rank_symbol.name}) \
+                | {s.name for s in node.partition_by} \
+                | {o.symbol.name for o in node.orderings}
+            src_syms = {s.name for s in node.source.output_symbols}
+            src = self.prune(node.source, need & src_syms)
+            return TopNRankingNode(src, node.partition_by,
+                                   node.orderings, node.ranking,
+                                   node.max_rank, node.rank_symbol,
+                                   node.step)
+
         from .plan import WindowNode
 
         if isinstance(node, WindowNode):
@@ -260,11 +273,16 @@ def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
     if isinstance(node, OutputNode):
         return OutputNode(sources[0], node.column_names, node.outputs)
     from .plan import (ExchangeNode, RemoteSourceNode, TableWriterNode,
-                       UnnestNode, WindowNode)
+                       TopNRankingNode, UnnestNode, WindowNode)
 
     if isinstance(node, WindowNode):
         return WindowNode(sources[0], node.partition_by, node.orderings,
                           node.functions)
+    if isinstance(node, TopNRankingNode):
+        return TopNRankingNode(sources[0], node.partition_by,
+                               node.orderings, node.ranking,
+                               node.max_rank, node.rank_symbol,
+                               node.step)
     if isinstance(node, UnnestNode):
         return UnnestNode(sources[0], node.array_symbols,
                           node.element_symbols, node.ordinality_symbol)
@@ -273,7 +291,8 @@ def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
                                node.table_name, node.columns,
                                node.rows_symbol, node.create)
     if isinstance(node, ExchangeNode):
-        return ExchangeNode(sources[0], node.kind, node.keys)
+        return ExchangeNode(sources[0], node.kind, node.keys,
+                            node.orderings)
     if isinstance(node, (TableScanNode, ValuesNode, RemoteSourceNode)):
         return node
     raise AssertionError(f"unknown node {type(node).__name__}")

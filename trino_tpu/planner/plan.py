@@ -394,11 +394,13 @@ class ExchangeNode(PlanNode):
     """A stage boundary (reference: sql/planner/plan/ExchangeNode.java,
     scope=REMOTE). ``kind``: 'hash' (partition rows on ``keys``),
     'single' (gather to one task), 'broadcast' (replicate to every
-    consumer task)."""
+    consumer task), 'merge' (gather preserving each producer task's
+    sort order — the consumer k-way merges per ``orderings``)."""
 
     source: PlanNode
     kind: str
     keys: List[Symbol]
+    orderings: Optional[List[Ordering]] = None  # kind == 'merge'
 
     @property
     def sources(self):
@@ -417,6 +419,7 @@ class RemoteSourceNode(PlanNode):
     fragment_id: int
     symbols: List[Symbol]
     kind: str  # of the originating exchange
+    orderings: Optional[List[Ordering]] = None  # kind == 'merge'
 
     @property
     def output_symbols(self):
@@ -438,6 +441,35 @@ class OutputNode(PlanNode):
     @property
     def output_symbols(self):
         return list(self.outputs)
+
+
+@dataclass
+class TopNRankingNode(PlanNode):
+    """Per-group top-N under a ranking function (reference:
+    sql/planner/plan/TopNRankingNode.java, lowered from a row_number/
+    rank window + a bound on its output). ``step='partial'`` truncates
+    each task's groups BEFORE the exchange (the scalability point: at
+    most groups*max_rank rows cross the wire); the final step re-ranks
+    and emits the rank symbol."""
+
+    source: PlanNode
+    partition_by: List[Symbol]
+    orderings: List[Ordering]
+    ranking: str                    # row_number | rank
+    max_rank: int
+    rank_symbol: Symbol
+    step: str = "single"            # single | partial | final
+
+    @property
+    def sources(self):
+        return [self.source]
+
+    @property
+    def output_symbols(self):
+        base = list(self.source.output_symbols)
+        if self.step == "partial":
+            return base
+        return base + [self.rank_symbol]
 
 
 # ---------------------------------------------------------------------------
@@ -489,6 +521,12 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
             detail += f" limit {node.count}"
     elif isinstance(node, LimitNode):
         detail = f" {node.count} offset {node.offset}"
+    elif isinstance(node, TopNRankingNode):
+        detail = (f" [{node.step}] {node.ranking}<="
+                  f"{node.max_rank} by={[s.name for s in node.partition_by]}"
+                  " order " + ", ".join(
+                      f"{o.symbol.name} {'asc' if o.ascending else 'desc'}"
+                      for o in node.orderings))
     elif isinstance(node, OutputNode):
         detail = f" {node.column_names}"
     out = f"{pad}- {name}{detail}\n"

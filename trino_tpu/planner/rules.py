@@ -222,6 +222,59 @@ class InlineProjections(Rule):
         return ProjectNode(inner.source, merged)
 
 
+class FilterOverWindowToTopNRanking(Rule):
+    """A bound on a row_number()/rank() window output lowers the window
+    to per-group top-N (reference:
+    iterative/rule/PushdownFilterIntoWindow.java producing
+    TopNRankingNode): the engine then truncates groups BEFORE the
+    exchange instead of materializing whole window partitions. The
+    original filter stays above (re-filtering is a no-op) so residual
+    conjuncts and exact bounds keep their semantics."""
+
+    name = "FilterOverWindowToTopNRanking"
+    pattern = Pattern(FilterNode)
+
+    def apply(self, node: FilterNode, ctx: RuleContext):
+        from .plan import TopNRankingNode, WindowNode
+
+        win = ctx.lookup.resolve(node.source)
+        if not isinstance(win, WindowNode) or len(win.functions) != 1:
+            return None
+        out_sym, spec = win.functions[0]
+        if spec.function not in ("row_number", "rank") \
+                or not win.orderings:
+            return None
+        bound = None
+        for p in conjuncts(node.predicate):
+            k = _rank_bound(p, out_sym.name)
+            if k is not None:
+                bound = k if bound is None else min(bound, k)
+        if bound is None or bound < 1:
+            return None
+        topn = TopNRankingNode(win.source, list(win.partition_by),
+                               list(win.orderings), spec.function,
+                               bound, out_sym)
+        return FilterNode(topn, node.predicate)
+
+
+def _rank_bound(p, name: str):
+    """k such that conjunct p implies rank <= k, else None."""
+    from ..expr.ir import Literal as Lit
+
+    if not isinstance(p, Call) or len(p.args) != 2:
+        return None
+    a, b = p.args
+    if isinstance(a, SymbolRef) and a.name == name and isinstance(b, Lit) \
+            and isinstance(b.value, int):
+        return {"le": b.value, "lt": b.value - 1,
+                "eq": b.value}.get(p.name)
+    if isinstance(b, SymbolRef) and b.name == name and isinstance(a, Lit) \
+            and isinstance(a.value, int):
+        return {"ge": a.value, "gt": a.value - 1,
+                "eq": a.value}.get(p.name)
+    return None
+
+
 def negotiate_scan_pushdown(metadata, session, scan: TableScanNode,
                             preds: List[RowExpression]
                             ) -> Optional[Tuple[TableScanNode,
@@ -556,6 +609,7 @@ def _subsets_of_size(n: int, size: int):
 
 def default_rules() -> List[Rule]:
     return [
+        FilterOverWindowToTopNRanking(),
         MergeFilters(),
         PushFilterThroughProject(),
         PushFilterThroughAggregation(),
