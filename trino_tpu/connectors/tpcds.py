@@ -99,6 +99,16 @@ def _counts(sf: float) -> Dict[str, int]:
     c["catalog_returns"] = c["catalog_sales"] // 3
     c["inventory"] = ((_DS_DAYS + 6) // 7) * c["warehouse"] \
         * min(c["item"], max(200, int(c["item"] * 0.2)))
+    # web channel + remaining dimensions (full 24-table schema)
+    c["time_dim"] = 86_400
+    c["reason"] = 35
+    c["ship_mode"] = 20
+    c["call_center"] = max(2, int(6 * sf))
+    c["catalog_page"] = max(100, int(11_718 * sf))
+    c["web_site"] = max(2, int(30 * sf))
+    c["web_page"] = max(10, int(60 * sf))
+    c["web_sales"] = max(100, int(720_000 * sf))
+    c["web_returns"] = c["web_sales"] // 3
     return c
 
 
@@ -242,6 +252,99 @@ _TABLE_COLUMNS: Dict[str, List] = {
         ("cs_ext_ship_cost", D72), ("cs_net_paid", D72),
         ("cs_net_paid_inc_tax", D72), ("cs_net_paid_inc_ship", D72),
         ("cs_net_paid_inc_ship_tax", D72), ("cs_net_profit", D72)],
+    "time_dim": [
+        ("t_time_sk", T.BIGINT), ("t_time_id", V(16)),
+        ("t_time", T.BIGINT), ("t_hour", T.BIGINT),
+        ("t_minute", T.BIGINT), ("t_second", T.BIGINT),
+        ("t_am_pm", V(2)), ("t_shift", V(20)), ("t_sub_shift", V(20)),
+        ("t_meal_time", V(20))],
+    "reason": [
+        ("r_reason_sk", T.BIGINT), ("r_reason_id", V(16)),
+        ("r_reason_desc", V(100))],
+    "ship_mode": [
+        ("sm_ship_mode_sk", T.BIGINT), ("sm_ship_mode_id", V(16)),
+        ("sm_type", V(30)), ("sm_code", V(10)), ("sm_carrier", V(20)),
+        ("sm_contract", V(20))],
+    "call_center": [
+        ("cc_call_center_sk", T.BIGINT), ("cc_call_center_id", V(16)),
+        ("cc_rec_start_date", T.DATE), ("cc_rec_end_date", T.DATE),
+        ("cc_closed_date_sk", T.BIGINT), ("cc_open_date_sk", T.BIGINT),
+        ("cc_name", V(50)), ("cc_class", V(50)),
+        ("cc_employees", T.BIGINT), ("cc_sq_ft", T.BIGINT),
+        ("cc_hours", V(20)), ("cc_manager", V(40)),
+        ("cc_mkt_id", T.BIGINT), ("cc_mkt_class", V(50)),
+        ("cc_mkt_desc", V(100)), ("cc_market_manager", V(40)),
+        ("cc_division", T.BIGINT), ("cc_division_name", V(50)),
+        ("cc_company", T.BIGINT), ("cc_company_name", V(50)),
+        ("cc_street_number", V(10)), ("cc_street_name", V(60)),
+        ("cc_street_type", V(15)), ("cc_suite_number", V(10)),
+        ("cc_city", V(60)), ("cc_county", V(30)), ("cc_state", V(2)),
+        ("cc_zip", V(10)), ("cc_country", V(20)),
+        ("cc_gmt_offset", D52), ("cc_tax_percentage", D52)],
+    "catalog_page": [
+        ("cp_catalog_page_sk", T.BIGINT), ("cp_catalog_page_id", V(16)),
+        ("cp_start_date_sk", T.BIGINT), ("cp_end_date_sk", T.BIGINT),
+        ("cp_department", V(50)), ("cp_catalog_number", T.BIGINT),
+        ("cp_catalog_page_number", T.BIGINT), ("cp_description", V(100)),
+        ("cp_type", V(100))],
+    "web_site": [
+        ("web_site_sk", T.BIGINT), ("web_site_id", V(16)),
+        ("web_rec_start_date", T.DATE), ("web_rec_end_date", T.DATE),
+        ("web_name", V(50)), ("web_open_date_sk", T.BIGINT),
+        ("web_close_date_sk", T.BIGINT), ("web_class", V(50)),
+        ("web_manager", V(40)), ("web_mkt_id", T.BIGINT),
+        ("web_mkt_class", V(50)), ("web_mkt_desc", V(100)),
+        ("web_market_manager", V(40)), ("web_company_id", T.BIGINT),
+        ("web_company_name", V(50)), ("web_street_number", V(10)),
+        ("web_street_name", V(60)), ("web_street_type", V(15)),
+        ("web_suite_number", V(10)), ("web_city", V(60)),
+        ("web_county", V(30)), ("web_state", V(2)), ("web_zip", V(10)),
+        ("web_country", V(20)), ("web_gmt_offset", D52),
+        ("web_tax_percentage", D52)],
+    "web_page": [
+        ("wp_web_page_sk", T.BIGINT), ("wp_web_page_id", V(16)),
+        ("wp_rec_start_date", T.DATE), ("wp_rec_end_date", T.DATE),
+        ("wp_creation_date_sk", T.BIGINT), ("wp_access_date_sk", T.BIGINT),
+        ("wp_autogen_flag", V(1)), ("wp_customer_sk", T.BIGINT),
+        ("wp_url", V(100)), ("wp_type", V(50)),
+        ("wp_char_count", T.BIGINT), ("wp_link_count", T.BIGINT),
+        ("wp_image_count", T.BIGINT), ("wp_max_ad_count", T.BIGINT)],
+    "web_sales": [
+        ("ws_sold_date_sk", T.BIGINT), ("ws_sold_time_sk", T.BIGINT),
+        ("ws_ship_date_sk", T.BIGINT), ("ws_item_sk", T.BIGINT),
+        ("ws_bill_customer_sk", T.BIGINT), ("ws_bill_cdemo_sk", T.BIGINT),
+        ("ws_bill_hdemo_sk", T.BIGINT), ("ws_bill_addr_sk", T.BIGINT),
+        ("ws_ship_customer_sk", T.BIGINT), ("ws_ship_cdemo_sk", T.BIGINT),
+        ("ws_ship_hdemo_sk", T.BIGINT), ("ws_ship_addr_sk", T.BIGINT),
+        ("ws_web_page_sk", T.BIGINT), ("ws_web_site_sk", T.BIGINT),
+        ("ws_ship_mode_sk", T.BIGINT), ("ws_warehouse_sk", T.BIGINT),
+        ("ws_promo_sk", T.BIGINT), ("ws_order_number", T.BIGINT),
+        ("ws_quantity", T.BIGINT), ("ws_wholesale_cost", D72),
+        ("ws_list_price", D72), ("ws_sales_price", D72),
+        ("ws_ext_discount_amt", D72), ("ws_ext_sales_price", D72),
+        ("ws_ext_wholesale_cost", D72), ("ws_ext_list_price", D72),
+        ("ws_ext_tax", D72), ("ws_coupon_amt", D72),
+        ("ws_ext_ship_cost", D72), ("ws_net_paid", D72),
+        ("ws_net_paid_inc_tax", D72), ("ws_net_paid_inc_ship", D72),
+        ("ws_net_paid_inc_ship_tax", D72), ("ws_net_profit", D72)],
+    "web_returns": [
+        ("wr_returned_date_sk", T.BIGINT),
+        ("wr_returned_time_sk", T.BIGINT), ("wr_item_sk", T.BIGINT),
+        ("wr_refunded_customer_sk", T.BIGINT),
+        ("wr_refunded_cdemo_sk", T.BIGINT),
+        ("wr_refunded_hdemo_sk", T.BIGINT),
+        ("wr_refunded_addr_sk", T.BIGINT),
+        ("wr_returning_customer_sk", T.BIGINT),
+        ("wr_returning_cdemo_sk", T.BIGINT),
+        ("wr_returning_hdemo_sk", T.BIGINT),
+        ("wr_returning_addr_sk", T.BIGINT),
+        ("wr_web_page_sk", T.BIGINT), ("wr_reason_sk", T.BIGINT),
+        ("wr_order_number", T.BIGINT), ("wr_return_quantity", T.BIGINT),
+        ("wr_return_amt", D72), ("wr_return_tax", D72),
+        ("wr_return_amt_inc_tax", D72), ("wr_fee", D72),
+        ("wr_return_ship_cost", D72), ("wr_refunded_cash", D72),
+        ("wr_reversed_charge", D72), ("wr_account_credit", D72),
+        ("wr_net_loss", D72)],
     "catalog_returns": [
         ("cr_returned_date_sk", T.BIGINT),
         ("cr_returned_time_sk", T.BIGINT), ("cr_item_sk", T.BIGINT),
@@ -578,6 +681,282 @@ class _DsTable:
         out["w_gmt_offset"] = -(hmod(rows, "w.gmt", 4) + 5) * 100
         return out
 
+    def _gen_time_dim(self, sf, rows, cols):
+        sec = rows  # one row per second of day
+        h = sec // 3600
+        out = {}
+        out["t_time_sk"] = sec
+        out["t_time_id"] = [f"AAAAAAAA{v:08d}" for v in sec]
+        out["t_time"] = sec
+        out["t_hour"] = h
+        out["t_minute"] = (sec // 60) % 60
+        out["t_second"] = sec % 60
+        out["t_am_pm"] = (np.where(h < 12, 0, 1), ["AM", "PM"])
+        out["t_shift"] = (np.where(h < 8, 0, np.where(h < 16, 1, 2)),
+                          ["third", "first", "second"])
+        out["t_sub_shift"] = (np.where(h < 6, 0, np.where(
+            h < 12, 1, np.where(h < 18, 2, 3))),
+            ["night", "morning", "afternoon", "evening"])
+        out["t_meal_time"] = ((np.where(
+            (h >= 6) & (h < 9), 1, np.where(
+                (h >= 11) & (h < 14), 2, np.where(
+                    (h >= 17) & (h < 20), 3, 0)))),
+            ["", "breakfast", "lunch", "dinner"])
+        return out
+
+    def _gen_reason(self, sf, rows, cols):
+        k = rows + 1
+        return {"r_reason_sk": k,
+                "r_reason_id": [f"AAAAAAAA{v:08d}" for v in k],
+                "r_reason_desc": _words(rows, "r.desc", 3)}
+
+    def _gen_ship_mode(self, sf, rows, cols):
+        k = rows + 1
+        types = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "TWO DAY"]
+        carriers = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS",
+                    "ZHOU", "ZOUROS", "MSC", "LATVIAN"]
+        out = {}
+        out["sm_ship_mode_sk"] = k
+        out["sm_ship_mode_id"] = [f"AAAAAAAA{v:08d}" for v in k]
+        out["sm_type"] = (rows % len(types), types)
+        out["sm_code"] = (rows % 4, ["AIR", "SURFACE", "SEA", "RAIL"])
+        out["sm_carrier"] = (rows % len(carriers), carriers)
+        out["sm_contract"] = [f"{v:015d}" for v in
+                              h64(rows, "sm.contract")
+                              % np.uint64(10 ** 15)]
+        return out
+
+    def _gen_call_center(self, sf, rows, cols):
+        k = rows + 1
+        out = {}
+        out["cc_call_center_sk"] = k
+        out["cc_call_center_id"] = [f"AAAAAAAA{v:08d}" for v in k]
+        out["cc_rec_start_date"] = np.full(len(rows), _DS_START,
+                                           dtype=np.int32)
+        out["cc_rec_end_date"] = (np.zeros(len(rows), dtype=np.int32),
+                                  np.ones(len(rows), dtype=bool))
+        out["cc_closed_date_sk"] = (np.zeros(len(rows), dtype=np.int64),
+                                    np.ones(len(rows), dtype=bool))
+        out["cc_open_date_sk"] = _SK0 + hmod(rows, "cc.open", 365)
+        out["cc_name"] = [f"call center {v}" for v in k]
+        out["cc_class"] = (hmod(rows, "cc.class", 3),
+                           ["small", "medium", "large"])
+        out["cc_employees"] = 100 + hmod(rows, "cc.emp", 600)
+        out["cc_sq_ft"] = 10_000 + hmod(rows, "cc.sqft", 90_000)
+        out["cc_hours"] = _pick(rows, "cc.hours", HOURS)
+        out["cc_manager"] = _words(rows, "cc.mgr", 2)
+        out["cc_mkt_id"] = hmod(rows, "cc.mktid", 6) + 1
+        out["cc_mkt_class"] = _comment(rows, "cc.mktclass", 4)
+        out["cc_mkt_desc"] = _comment(rows, "cc.mktdesc", 8)
+        out["cc_market_manager"] = _words(rows, "cc.mktmgr", 2)
+        out["cc_division"] = hmod(rows, "cc.div", 6) + 1
+        out["cc_division_name"] = _words(rows, "cc.divname", 1)
+        out["cc_company"] = hmod(rows, "cc.co", 6) + 1
+        out["cc_company_name"] = _words(rows, "cc.coname", 1)
+        out["cc_street_number"] = [str(v) for v in
+                                   hmod(rows, "cc.stno", 999) + 1]
+        out["cc_street_name"] = _words(rows, "cc.stname", 2)
+        out["cc_street_type"] = _pick(rows, "cc.sttype", STREET_TYPES)
+        out["cc_suite_number"] = [f"Suite {v}" for v in
+                                  hmod(rows, "cc.suite", 99)]
+        out["cc_city"] = _words(rows, "cc.city", 1)
+        out["cc_county"] = _words(rows, "cc.county", 2)
+        out["cc_state"] = _pick(rows, "cc.state", STATES)
+        out["cc_zip"] = [f"{v:05d}" for v in hmod(rows, "cc.zip", 99_999)]
+        out["cc_country"] = ["United States"] * len(rows)
+        out["cc_gmt_offset"] = -(hmod(rows, "cc.gmt", 4) + 5) * 100
+        out["cc_tax_percentage"] = hmod(rows, "cc.tax", 12)
+        return out
+
+    def _gen_catalog_page(self, sf, rows, cols):
+        k = rows + 1
+        start = hmod(rows, "cp.start", _DS_DAYS - 90)
+        out = {}
+        out["cp_catalog_page_sk"] = k
+        out["cp_catalog_page_id"] = [f"AAAAAAAA{v:08d}" for v in k]
+        out["cp_start_date_sk"] = _SK0 + start
+        out["cp_end_date_sk"] = _SK0 + start + 30 + hmod(rows, "cp.len",
+                                                         60)
+        out["cp_department"] = ["DEPARTMENT"] * len(rows)
+        out["cp_catalog_number"] = rows // 100 + 1
+        out["cp_catalog_page_number"] = rows % 100 + 1
+        out["cp_description"] = _comment(rows, "cp.desc", 8)
+        out["cp_type"] = (hmod(rows, "cp.type", 3),
+                          ["bi-annual", "quarterly", "monthly"])
+        return out
+
+    def _gen_web_site(self, sf, rows, cols):
+        k = rows + 1
+        out = {}
+        out["web_site_sk"] = k
+        out["web_site_id"] = [f"AAAAAAAA{v:08d}" for v in k]
+        out["web_rec_start_date"] = np.full(len(rows), _DS_START,
+                                            dtype=np.int32)
+        out["web_rec_end_date"] = (np.zeros(len(rows), dtype=np.int32),
+                                   np.ones(len(rows), dtype=bool))
+        out["web_name"] = [f"site_{v}" for v in rows % 15]
+        out["web_open_date_sk"] = _SK0 + hmod(rows, "web.open", 365)
+        out["web_close_date_sk"] = (np.zeros(len(rows), dtype=np.int64),
+                                    np.ones(len(rows), dtype=bool))
+        out["web_class"] = ["Unknown"] * len(rows)
+        out["web_manager"] = _words(rows, "web.mgr", 2)
+        out["web_mkt_id"] = hmod(rows, "web.mktid", 6) + 1
+        out["web_mkt_class"] = _comment(rows, "web.mktclass", 4)
+        out["web_mkt_desc"] = _comment(rows, "web.mktdesc", 8)
+        out["web_market_manager"] = _words(rows, "web.mktmgr", 2)
+        out["web_company_id"] = hmod(rows, "web.co", 6) + 1
+        out["web_company_name"] = (hmod(rows, "web.coname", 6),
+                                   ["pri", "able", "ought", "bar",
+                                    "cally", "ation"])
+        out["web_street_number"] = [str(v) for v in
+                                    hmod(rows, "web.stno", 999) + 1]
+        out["web_street_name"] = _words(rows, "web.stname", 2)
+        out["web_street_type"] = _pick(rows, "web.sttype", STREET_TYPES)
+        out["web_suite_number"] = [f"Suite {v}" for v in
+                                   hmod(rows, "web.suite", 99)]
+        out["web_city"] = _words(rows, "web.city", 1)
+        out["web_county"] = _words(rows, "web.county", 2)
+        out["web_state"] = _pick(rows, "web.state", STATES)
+        out["web_zip"] = [f"{v:05d}" for v in
+                          hmod(rows, "web.zip", 99_999)]
+        out["web_country"] = ["United States"] * len(rows)
+        out["web_gmt_offset"] = -(hmod(rows, "web.gmt", 4) + 5) * 100
+        out["web_tax_percentage"] = hmod(rows, "web.tax", 12)
+        return out
+
+    def _gen_web_page(self, sf, rows, cols):
+        c = _counts(sf)
+        k = rows + 1
+        out = {}
+        out["wp_web_page_sk"] = k
+        out["wp_web_page_id"] = [f"AAAAAAAA{v:08d}" for v in k]
+        out["wp_rec_start_date"] = np.full(len(rows), _DS_START,
+                                           dtype=np.int32)
+        out["wp_rec_end_date"] = (np.zeros(len(rows), dtype=np.int32),
+                                  np.ones(len(rows), dtype=bool))
+        out["wp_creation_date_sk"] = _SK0 + hmod(rows, "wp.create", 365)
+        out["wp_access_date_sk"] = _SK0 + 365 + hmod(rows, "wp.access",
+                                                     365)
+        out["wp_autogen_flag"] = _yn(rows, "wp.autogen")
+        out["wp_customer_sk"] = hmod(rows, "wp.cust",
+                                     c["customer"]) + 1
+        out["wp_url"] = ["http://www.foo.com"] * len(rows)
+        out["wp_type"] = (hmod(rows, "wp.type", 7),
+                          ["ad", "bio", "dynamic", "feedback",
+                           "general", "order", "welcome"])
+        out["wp_char_count"] = 100 + hmod(rows, "wp.chars", 8_000)
+        out["wp_link_count"] = 2 + hmod(rows, "wp.links", 23)
+        out["wp_image_count"] = 1 + hmod(rows, "wp.imgs", 6)
+        out["wp_max_ad_count"] = hmod(rows, "wp.ads", 5)
+        return out
+
+    def _ws_values(self, sf, rows):
+        """web_sales column streams (shared with web_returns)."""
+        c = _counts(sf)
+        ni = _inv_items(sf)
+        out = {}
+        sold = hmod(rows, "ws.sold", _SOLD_DAYS)
+        out["ws_sold_date_sk"] = _SK0 + sold
+        out["ws_sold_time_sk"] = hmod(rows, "ws.time", 86_400)
+        ship = np.minimum(sold + 2 + hmod(rows, "ws.shiplag", 58),
+                          _DS_DAYS - 1)
+        out["ws_ship_date_sk"] = _SK0 + ship
+        out["ws_item_sk"] = np.where(
+            hmod(rows, "ws.itempick", 4) < 3,
+            hmod(rows, "ws.itemA", ni) + 1,
+            hmod(rows, "ws.itemB", c["item"]) + 1)
+        cust = hmod(rows, "ws.cust", c["customer"]) + 1
+        out["ws_bill_customer_sk"] = cust
+        out["ws_bill_cdemo_sk"] = hmod(rows, "ws.cdemo",
+                                       c["customer_demographics"]) + 1
+        out["ws_bill_hdemo_sk"] = hmod(rows, "ws.hdemo",
+                                       c["household_demographics"]) + 1
+        out["ws_bill_addr_sk"] = hmod(rows, "ws.addr",
+                                      c["customer_address"]) + 1
+        out["ws_ship_customer_sk"] = cust
+        out["ws_ship_cdemo_sk"] = out["ws_bill_cdemo_sk"]
+        out["ws_ship_hdemo_sk"] = out["ws_bill_hdemo_sk"]
+        out["ws_ship_addr_sk"] = out["ws_bill_addr_sk"]
+        out["ws_web_page_sk"] = hmod(rows, "ws.page",
+                                     c["web_page"]) + 1
+        out["ws_web_site_sk"] = hmod(rows, "ws.site",
+                                     c["web_site"]) + 1
+        out["ws_ship_mode_sk"] = hmod(rows, "ws.shipmode",
+                                      c["ship_mode"]) + 1
+        out["ws_warehouse_sk"] = hmod(rows, "ws.wh",
+                                      c["warehouse"]) + 1
+        promo_null = hmod(rows, "ws.promo.null", 5) == 0
+        out["ws_promo_sk"] = (hmod(rows, "ws.promo",
+                                   c["promotion"]) + 1, promo_null)
+        out["ws_order_number"] = rows // 4 + 1
+        qty = hmod(rows, "ws.qty", 100) + 1
+        out["ws_quantity"] = qty
+        whole = 100 + hmod(rows, "ws.whole", 9_900)
+        lst = whole + (whole * (20 + hmod(rows, "ws.markup", 80))) // 100
+        disc = hmod(rows, "ws.disc", 30)
+        sales = (lst * (100 - disc)) // 100
+        out["ws_wholesale_cost"] = whole
+        out["ws_list_price"] = lst
+        out["ws_sales_price"] = sales
+        out["ws_ext_discount_amt"] = qty * (lst - sales)
+        out["ws_ext_sales_price"] = qty * sales
+        out["ws_ext_wholesale_cost"] = qty * whole
+        out["ws_ext_list_price"] = qty * lst
+        tax = (qty * sales * hmod(rows, "ws.tax", 9)) // 100
+        out["ws_ext_tax"] = tax
+        coupon = np.where(hmod(rows, "ws.coup", 10) == 0,
+                          (qty * sales) // 10, 0)
+        out["ws_coupon_amt"] = coupon
+        shipc = qty * hmod(rows, "ws.shipc", 1_000)
+        out["ws_ext_ship_cost"] = shipc
+        net = qty * sales - coupon
+        out["ws_net_paid"] = net
+        out["ws_net_paid_inc_tax"] = net + tax
+        out["ws_net_paid_inc_ship"] = net + shipc
+        out["ws_net_paid_inc_ship_tax"] = net + shipc + tax
+        out["ws_net_profit"] = net - qty * whole
+        return out
+
+    def _gen_web_sales(self, sf, rows, cols):
+        return self._ws_values(sf, rows)
+
+    def _gen_web_returns(self, sf, rows, cols):
+        parent = rows * 3
+        ws = self._ws_values(sf, parent)
+        out = {}
+        sold = ws["ws_sold_date_sk"] - _SK0
+        ret = np.minimum(sold + 1 + hmod(rows, "wr.lag", 60),
+                         _DS_DAYS - 1)
+        out["wr_returned_date_sk"] = _SK0 + ret
+        out["wr_returned_time_sk"] = hmod(rows, "wr.time", 86_400)
+        out["wr_item_sk"] = ws["ws_item_sk"]
+        out["wr_refunded_customer_sk"] = ws["ws_bill_customer_sk"]
+        out["wr_refunded_cdemo_sk"] = ws["ws_bill_cdemo_sk"]
+        out["wr_refunded_hdemo_sk"] = ws["ws_bill_hdemo_sk"]
+        out["wr_refunded_addr_sk"] = ws["ws_bill_addr_sk"]
+        out["wr_returning_customer_sk"] = ws["ws_bill_customer_sk"]
+        out["wr_returning_cdemo_sk"] = ws["ws_bill_cdemo_sk"]
+        out["wr_returning_hdemo_sk"] = ws["ws_bill_hdemo_sk"]
+        out["wr_returning_addr_sk"] = ws["ws_bill_addr_sk"]
+        out["wr_web_page_sk"] = ws["ws_web_page_sk"]
+        out["wr_reason_sk"] = hmod(rows, "wr.reason", 35) + 1
+        out["wr_order_number"] = ws["ws_order_number"]
+        rqty = 1 + hmod(rows, "wr.qty", 100) % ws["ws_quantity"]
+        out["wr_return_quantity"] = rqty
+        amt = rqty * ws["ws_sales_price"]
+        out["wr_return_amt"] = amt
+        tax = (amt * hmod(rows, "wr.tax", 9)) // 100
+        out["wr_return_tax"] = tax
+        out["wr_return_amt_inc_tax"] = amt + tax
+        out["wr_fee"] = hmod(rows, "wr.fee", 10_000)
+        out["wr_return_ship_cost"] = hmod(rows, "wr.shipc", 5_000)
+        third = amt // 3
+        out["wr_refunded_cash"] = third
+        out["wr_reversed_charge"] = third
+        out["wr_account_credit"] = amt - 2 * third
+        out["wr_net_loss"] = hmod(rows, "wr.loss", 10_000)
+        return out
+
     # -- facts ---------------------------------------------------------
 
     def _gen_inventory(self, sf, rows, cols):
@@ -689,13 +1068,27 @@ class _DsTable:
         c = _counts(sf)
         ni = _inv_items(sf)
         out = {}
-        sold = hmod(rows, "cs.sold", _SOLD_DAYS)
+        # a quarter of catalog orders are REPURCHASES: they reuse the
+        # (customer, item) of a returned store sale and sell 1-3 months
+        # after it, so the cross-channel chain queries (q25/q29:
+        # sale -> return -> catalog re-purchase) find join partners
+        echo = hmod(rows, "cs.echo", 4) == 0
+        ss_parent = (rows % np.int64(max(c["store_sales"] // 2, 1))) * 2
+        y99 = days_from_civil_host(1999, 1, 1) - _DS_START
+        parent_sold = y99 + hmod(ss_parent, "ss.sold", 730)
+        echo_sold = np.minimum(parent_sold + 30 + hmod(rows, "cs.relag",
+                                                       60),
+                               _SOLD_DAYS - 1)
+        sold = np.where(echo, echo_sold,
+                        hmod(rows, "cs.sold", _SOLD_DAYS))
         out["cs_sold_date_sk"] = _SK0 + sold
         out["cs_sold_time_sk"] = hmod(rows, "cs.time", 86_400)
         ship = np.minimum(sold + 2 + hmod(rows, "cs.shiplag", 58),
                           _DS_DAYS - 1)
         out["cs_ship_date_sk"] = _SK0 + ship
-        cust = hmod(rows, "cs.cust", c["customer"]) + 1
+        echo_cust = hmod(ss_parent, "ss.cust", c["customer"]) + 1
+        cust = np.where(echo, echo_cust,
+                        hmod(rows, "cs.cust", c["customer"]) + 1)
         out["cs_bill_customer_sk"] = cust
         out["cs_bill_cdemo_sk"] = hmod(rows, "cs.cdemo",
                                        c["customer_demographics"]) + 1
@@ -707,15 +1100,24 @@ class _DsTable:
         out["cs_ship_cdemo_sk"] = out["cs_bill_cdemo_sk"]
         out["cs_ship_hdemo_sk"] = out["cs_bill_hdemo_sk"]
         out["cs_ship_addr_sk"] = out["cs_bill_addr_sk"]
-        out["cs_call_center_sk"] = hmod(rows, "cs.cc", 6) + 1
-        out["cs_catalog_page_sk"] = hmod(rows, "cs.page", 11_718) + 1
-        out["cs_ship_mode_sk"] = hmod(rows, "cs.shipmode", 20) + 1
+        out["cs_call_center_sk"] = hmod(rows, "cs.cc",
+                                        c["call_center"]) + 1
+        out["cs_catalog_page_sk"] = hmod(rows, "cs.page",
+                                         c["catalog_page"]) + 1
+        out["cs_ship_mode_sk"] = hmod(rows, "cs.shipmode",
+                                      c["ship_mode"]) + 1
         out["cs_warehouse_sk"] = hmod(rows, "cs.wh", c["warehouse"]) + 1
-        # bias toward inventory-covered items (q72 joins inventory)
+        # bias toward inventory-covered items (q72 joins inventory);
+        # repurchase rows reuse the parent store sale's item
+        echo_item = np.where(
+            hmod(ss_parent, "ss.itempick", 2) == 0,
+            hmod(ss_parent, "ss.itemA", ni) + 1,
+            hmod(ss_parent, "ss.itemB", c["item"]) + 1)
         out["cs_item_sk"] = np.where(
-            hmod(rows, "cs.itempick", 4) < 3,
-            hmod(rows, "cs.itemA", ni) + 1,
-            hmod(rows, "cs.itemB", c["item"]) + 1)
+            echo, echo_item, np.where(
+                hmod(rows, "cs.itempick", 4) < 3,
+                hmod(rows, "cs.itemA", ni) + 1,
+                hmod(rows, "cs.itemB", c["item"]) + 1))
         promo_null = hmod(rows, "cs.promo.null", 5) == 0
         out["cs_promo_sk"] = (hmod(rows, "cs.promo",
                                    c["promotion"]) + 1, promo_null)
