@@ -31,7 +31,13 @@ hang *after* a successful probe):
     phase-1 solo rate for the ratio.
 
 Env: BENCH_SCHEMA (micro|tiny|sf1; default tiny), BENCH_DEADLINE (s),
-BENCH_TPU_BUDGET (s). Internal: BENCH_ROLE=measure BENCH_PLATFORM=cpu|default.
+BENCH_TPU_BUDGET (s), BENCH_QUERIES (comma list of q1|q3|q18; default
+"q1,q3" — q18 is the large-group aggregation stressor). Each rate line
+is preceded by a ``*_stage_wall_ms`` line carrying the per-stage
+(scan/filter-project/agg/join/exchange/sort) wall-time breakdown of the
+final repeat and the query's per-kernel jit-trace deltas (all repeats
+of that query; the first pays them). Internal: BENCH_ROLE=measure
+BENCH_PLATFORM=cpu|default.
 """
 
 import json
@@ -54,7 +60,7 @@ def _measure_child():
     platform = os.environ.get("BENCH_PLATFORM", "default")
     queries = [q.strip()
                for q in os.environ.get("BENCH_QUERIES", "q1,q3").split(",")]
-    unknown = [q for q in queries if q not in ("q1", "q3")]
+    unknown = [q for q in queries if q not in ("q1", "q3", "q18")]
     if unknown:
         raise SystemExit(f"unknown BENCH_QUERIES entries: {unknown}")
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
@@ -73,7 +79,9 @@ def _measure_child():
                      f"{time.time() - t0:.1f}s\n")
 
     from trino_tpu.benchmarks import (build_q1_driver, build_q3_drivers,
-                                      scan_q1_pages, scan_q3_pages)
+                                      build_q18_driver, scan_q1_pages,
+                                      scan_q18_pages, scan_q3_pages,
+                                      stage_breakdown)
     from trino_tpu.connectors.tpch import TpchConnector
 
     conn = TpchConnector(page_rows=1 << 16)
@@ -83,33 +91,58 @@ def _measure_child():
             pages = scan_q1_pages(conn, schema, desired_splits=8)
             total_rows = sum(p.num_rows for p in pages)
 
-            def make_drivers():
+            def make_drivers(stats=False):
                 return [build_q1_driver(conn, schema,
-                                        source_pages=list(pages))[0]]
+                                        source_pages=list(pages),
+                                        collect_stats=stats)[0]]
+        elif query == "q18":
+            li18 = scan_q18_pages(conn, schema, desired_splits=8)
+            total_rows = sum(p.num_rows for p in li18)
+
+            def make_drivers(stats=False):
+                return [build_q18_driver(li18, collect_stats=stats)[0]]
         else:
             cust, orders, li = scan_q3_pages(conn, schema,
                                              desired_splits=8)
             total_rows = sum(p.num_rows for p in li)
 
-            def make_drivers():
-                return build_q3_drivers(cust, orders, li)[0]
+            def make_drivers(stats=False):
+                return build_q3_drivers(cust, orders, li,
+                                        collect_stats=stats)[0]
         sys.stderr.write(f"child[{platform}]: {query} {total_rows} rows "
                          f"generated {time.time() - t0:.1f}s\n")
+        from trino_tpu import jit_stats
+
+        traces_before = jit_stats.counts()
         times = []
+        breakdown = None
         for i in range(repeats):
-            drivers = make_drivers()
+            # the last repeat collects per-operator stats: its stage
+            # breakdown ships with the RESULT line (timing overhead is
+            # two clock reads per page move — noise); compile counts on
+            # it are ~0 since earlier repeats paid the traces
+            stats = i == repeats - 1
+            drivers = make_drivers(stats=stats)
             r0 = time.perf_counter()
             for d in drivers:
                 d.run_to_completion()
             times.append(time.perf_counter() - r0)
+            if stats:
+                breakdown = stage_breakdown(drivers)
             sys.stderr.write(f"child[{platform}]: {query} run "
                              f"{i + 1}/{repeats} {times[-1]:.3f}s\n")
+        # per-query trace delta (all repeats of THIS query; the first
+        # repeat pays them, later same-shape repeats must add none)
+        traces = {k: v - traces_before.get(k, 0)
+                  for k, v in jit_stats.counts().items()
+                  if v != traces_before.get(k, 0)}
         # first run pays compilation; take the best of the rest
         best = min(times[1:]) if len(times) > 1 else times[0]
         print("RESULT " + json.dumps({
             "query": query, "schema": schema, "platform": platform,
             "device": str(devs[0]), "rows": total_rows,
             "secs": best, "rate": total_rows / best,
+            "stages": breakdown, "jit_traces": traces,
         }), flush=True)
 
 
@@ -166,6 +199,18 @@ def _base_for(cache, res):
 
 def _emit(state, res, suffix, base):
     q = res.get("query", "q1")
+    if res.get("stages"):
+        # per-stage wall-time breakdown + jit-trace counts ride along as
+        # a non-headline metric line (printed BEFORE the rate line so
+        # the headline stays last on stdout)
+        bd = res["stages"]
+        total = round(sum(bd["stage_ms"].values()), 1)
+        print(json.dumps({
+            "metric": f"tpch_{q}_{res['schema']}_stage_wall_ms{suffix}",
+            "value": total, "unit": "ms", "vs_baseline": 0.0,
+            "stages": bd["stage_ms"], "compiles": bd["compiles"],
+            "jit_traces": res.get("jit_traces"),
+        }), flush=True)
     line = json.dumps({
         "metric": f"tpch_{q}_{res['schema']}_rows_per_sec{suffix}",
         "value": round(res["rate"], 1),

@@ -1,0 +1,88 @@
+"""Bench-harness smoke tests: the perf plumbing cannot silently rot.
+
+The fast test asserts both group-by paths (hash table vs sort oracle)
+produce identical q1 results on the micro schema through the REAL bench
+pipeline builders. The slow-marked test runs the bench measurement
+child itself (BENCH_SCHEMA=micro, CPU) end-to-end and checks the
+RESULT line carries the rate, the per-stage breakdown, and jit-trace
+counts.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _drain(sink):
+    from trino_tpu.block import Page
+
+    if not sink.pages:
+        return []
+    return Page.concat(sink.pages).to_rows()
+
+
+def test_q1_hash_and_sort_paths_identical():
+    from trino_tpu.benchmarks import build_q1_driver, scan_q1_pages
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    conn = TpchConnector(page_rows=4096)
+    pages = scan_q1_pages(conn, "micro", desired_splits=4)
+    rows = {}
+    for label, hg in (("hash", True), ("sort", False)):
+        driver, sink = build_q1_driver(conn, "micro",
+                                       source_pages=list(pages),
+                                       hash_grouping=hg)
+        driver.run_to_completion()
+        rows[label] = sorted(_drain(sink))
+    assert rows["hash"] == rows["sort"]
+    assert len(rows["hash"]) == 4  # the 4 (returnflag, linestatus) groups
+
+
+def test_q18_hash_and_sort_paths_identical():
+    from trino_tpu.benchmarks import build_q18_driver, scan_q18_pages
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    conn = TpchConnector(page_rows=4096)
+    pages = scan_q18_pages(conn, "micro", desired_splits=4)
+    rows = {}
+    agg_groups = {}
+    for label, hg in (("hash", True), ("sort", False)):
+        driver, sink = build_q18_driver(pages, hash_grouping=hg,
+                                        collect_stats=True)
+        driver.run_to_completion()
+        rows[label] = sorted(_drain(sink))
+        agg_groups[label] = next(
+            st.output_rows for st in driver.stats
+            if st.name.startswith("HashAggregation"))
+    # the HAVING may filter micro down to nothing — the large-group
+    # aggregation itself is the point: both paths must produce the same
+    # (large) group count and the same final rows
+    assert rows["hash"] == rows["sort"]
+    assert agg_groups["hash"] == agg_groups["sort"] > 1000
+
+
+@pytest.mark.slow
+def test_bench_measure_child_micro_cpu():
+    env = dict(os.environ, BENCH_ROLE="measure", BENCH_PLATFORM="cpu",
+               BENCH_SCHEMA="micro", BENCH_QUERIES="q1,q18",
+               BENCH_REPEATS="2")
+    env.pop("BENCH_DEADLINE", None)
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    results = [json.loads(line[len("RESULT "):])
+               for line in proc.stdout.splitlines()
+               if line.startswith("RESULT ")]
+    assert [r["query"] for r in results] == ["q1", "q18"]
+    for r in results:
+        assert r["rate"] > 0
+        assert r["stages"]["stage_ms"]["agg"] >= 0
+        assert set(r["stages"]["stage_ms"]) >= {
+            "scan", "filter_project", "agg", "join", "exchange"}
+        assert r["jit_traces"].get("hash_group_ids", 0) > 0

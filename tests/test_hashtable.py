@@ -1,0 +1,342 @@
+"""Hash-based vs sort-based grouping cross-checks.
+
+The vectorized open-addressing table (ops/hashtable.py) is the default
+grouping path; the sort path is retained as the correctness oracle.
+These tests drive both paths over adversarial key distributions —
+all-null keys, a single group, near-capacity cardinality (forcing
+linear-probe chains at load factor 0.5), multi-key pages, int64 and
+float32 state columns — and require identical results.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.block import DevicePage, Dictionary, Page
+from trino_tpu.ops.aggregation import AggCall, HashAggregationOperator, \
+    resolve_agg_type
+from trino_tpu.ops.hashtable import hash_group_ids, hashable_key_types
+from trino_tpu.ops.sortkeys import group_operands
+
+
+# ---------------------------------------------------------------- primitive
+
+
+def _reference_gids(keys_cols, nulls_cols, n):
+    """First-occurrence dense group ids over tuples of (is_null, value)."""
+    seen = {}
+    out = []
+    for i in range(n):
+        k = tuple((bool(nc[i]), None if nc[i] else int(kc[i]))
+                  for kc, nc in zip(keys_cols, nulls_cols))
+        out.append(seen.setdefault(k, len(seen)))
+    return out, len(seen)
+
+
+@pytest.mark.parametrize("nvals,n,cap", [
+    (4, 13, 16),          # few groups
+    (1, 13, 16),          # single group
+    (10**9, 61, 64),      # near-capacity: all keys distinct
+    (50, 1000, 1024),
+    (10**9, 1021, 1024),  # near-capacity at a real page size
+])
+def test_hash_gids_match_reference(nvals, n, cap):
+    rng = np.random.default_rng(n * 31 + nvals % 97)
+    keys = rng.integers(-nvals, nvals, size=cap).astype(np.int64)
+    nulls = rng.random(cap) < 0.15
+    valid = np.zeros(cap, dtype=bool)
+    valid[:n] = True
+    ops = group_operands(jnp.asarray(keys), jnp.asarray(nulls), T.BIGINT)
+    gid, group_rows, ngroups, overflow = hash_group_ids(
+        tuple(ops), jnp.asarray(valid))
+    gid, group_rows = np.asarray(gid), np.asarray(group_rows)
+    assert not bool(overflow)
+    ref, nref = _reference_gids([keys], [nulls], n)
+    assert int(ngroups) == nref
+    assert gid[:n].tolist() == ref
+    assert (gid[n:] == cap).all()
+    for g in range(nref):
+        r = group_rows[g]
+        assert gid[r] == g and (gid[:r] != g).all(), \
+            "group_rows must point at the FIRST row of each group"
+
+
+def test_hash_gids_multi_key_and_all_null():
+    cap = 64
+    n = 50
+    rng = np.random.default_rng(7)
+    k1 = rng.integers(0, 5, size=cap).astype(np.int64)
+    k2 = rng.integers(0, 4, size=cap).astype(np.int64)
+    n1 = np.zeros(cap, dtype=bool)
+    n2 = np.ones(cap, dtype=bool)     # second key entirely NULL
+    valid = np.arange(cap) < n
+    ops = group_operands(jnp.asarray(k1), jnp.asarray(n1), T.BIGINT) \
+        + group_operands(jnp.asarray(k2), jnp.asarray(n2), T.BIGINT)
+    gid, _rows, ngroups, overflow = hash_group_ids(
+        tuple(ops), jnp.asarray(valid))
+    assert not bool(overflow)
+    ref, nref = _reference_gids([k1, k2], [n1, n2], n)
+    assert int(ngroups) == nref  # all-null key contributes one dimension
+    assert np.asarray(gid)[:n].tolist() == ref
+
+
+def test_probe_budget_overflow_is_flagged():
+    """With a 1-round budget, near-capacity distinct keys must collide
+    and exact mode must report overflow instead of wrong gids; the
+    non-exact (partial) mode resolves by singleton groups instead."""
+    cap = 256
+    keys = np.arange(cap, dtype=np.int64) * 7919
+    valid = np.ones(cap, dtype=bool)
+    ops = group_operands(jnp.asarray(keys), None, T.BIGINT)
+    _gid, _rows, _ng, overflow = hash_group_ids(
+        tuple(ops), jnp.asarray(valid), rounds=1, exact=True)
+    assert bool(overflow)
+    gid, _rows, ngroups, overflow = hash_group_ids(
+        tuple(ops), jnp.asarray(valid), rounds=1, exact=False)
+    assert not bool(overflow)
+    # every row got SOME group; duplicates allowed, coverage is dense
+    gid = np.asarray(gid)
+    ng = int(ngroups)
+    assert ng >= cap // 2 and (gid < ng).all()
+
+
+def test_hashable_key_types_gate():
+    assert hashable_key_types([T.BIGINT, T.varchar_type(10), T.DATE])
+    assert not hashable_key_types([T.BIGINT, T.DOUBLE])
+    assert not hashable_key_types([T.REAL])
+    assert hashable_key_types([])
+
+
+# ---------------------------------------------------------- operator oracle
+
+
+def _sorted_rows(rows):
+    return sorted(rows, key=lambda r: tuple(
+        (v is None, 0 if v is None else v) for v in r))
+
+
+def _run_single(input_types, columns, group_channels, aggs,
+                hash_grouping, page_rows=None):
+    """Run a single-step aggregation over the columns split into pages."""
+    n = len(columns[0])
+    page_rows = page_rows or n
+    # one pool per string column, shared across pages (the engine's
+    # dictionary-stability contract)
+    dicts = [Dictionary() if t.is_pooled else None for t in input_types]
+    op = HashAggregationOperator(input_types, group_channels, aggs,
+                                 "single", hash_grouping=hash_grouping)
+    for lo in range(0, n, page_rows):
+        chunk = [c[lo:lo + page_rows] for c in columns]
+        page = Page.from_pylists(input_types, chunk, dicts)
+        op.add_input(DevicePage.from_page(page))
+    op.finish()
+    pages = []
+    while not op.is_finished():
+        p = op.get_output()
+        if p is not None:
+            pages.append(p.to_page())
+    return _sorted_rows(Page.concat(pages).to_rows())
+
+
+def _assert_rows_equal(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert len(ra) == len(rb)
+        for va, vb in zip(ra, rb):
+            if isinstance(va, float) and va is not None and vb is not None:
+                assert vb == pytest.approx(va, rel=1e-9), (ra, rb)
+            else:
+                assert va == vb, (ra, rb)
+
+
+AGG_SUITE = [
+    AggCall("count_star", None, None, T.BIGINT),
+    AggCall("sum", 1, T.BIGINT, resolve_agg_type("sum", T.BIGINT)),
+    AggCall("sum", 2, T.REAL, resolve_agg_type("sum", T.REAL)),
+    AggCall("min", 1, T.BIGINT, T.BIGINT),
+    AggCall("max", 2, T.REAL, T.REAL),
+    AggCall("count", 2, T.REAL, T.BIGINT),
+]
+AGG_TYPES = [T.BIGINT, T.BIGINT, T.REAL]
+
+
+def _payload(rng, nkeys):
+    s1 = [int(v) if rng.random() > 0.1 else None
+          for v in rng.integers(-1000, 1000, size=nkeys)]
+    s2 = [float(np.float32(v)) if rng.random() > 0.1 else None
+          for v in rng.normal(size=nkeys)]
+    return s1, s2
+
+
+@pytest.mark.parametrize("case", [
+    "all_null", "single_group", "near_capacity", "mixed"])
+def test_hash_vs_sort_single_key(case):
+    rng = np.random.default_rng(hash(case) % 2**32)
+    n = 700
+    if case == "all_null":
+        keys = [None] * n
+    elif case == "single_group":
+        keys = [42] * n
+    elif case == "near_capacity":
+        keys = [int(v) for v in np.arange(n) * 1_000_003]
+    else:
+        keys = [int(v) if rng.random() > 0.2 else None
+                for v in rng.integers(0, 40, size=n)]
+    s1, s2 = _payload(rng, n)
+    cols = [keys, s1, s2]
+    for page_rows in (n, 128):
+        got = _run_single(AGG_TYPES, cols, [0], AGG_SUITE, True, page_rows)
+        want = _run_single(AGG_TYPES, cols, [0], AGG_SUITE, False,
+                           page_rows)
+        _assert_rows_equal(got, want)
+
+
+def test_hash_vs_sort_multi_key_with_strings():
+    rng = np.random.default_rng(11)
+    n = 500
+    vt = T.varchar_type(8)
+    types = [T.BIGINT, vt, T.BIGINT, T.REAL]
+    k1 = [int(v) if rng.random() > 0.15 else None
+          for v in rng.integers(0, 9, size=n)]
+    k2 = [rng.choice(["aa", "bb", "cc", "dd"]) if rng.random() > 0.15
+          else None for _ in range(n)]
+    s1, s2 = _payload(rng, n)
+    aggs = [
+        AggCall("count_star", None, None, T.BIGINT),
+        AggCall("sum", 2, T.BIGINT, resolve_agg_type("sum", T.BIGINT)),
+        AggCall("min", 3, T.REAL, T.REAL),
+        AggCall("max", 1, vt, vt),   # string min/max rides rank LUTs
+    ]
+    cols = [k1, k2, s1, s2]
+    got = _run_single(types, cols, [0, 1], aggs, True, 128)
+    want = _run_single(types, cols, [0, 1], aggs, False, 128)
+    _assert_rows_equal(got, want)
+
+
+def test_float_keys_fall_back_to_sort():
+    """DOUBLE grouping keys are not hashable (no f64<->u64 bitcast on
+    TPU): the operator must silently take the sort path and still be
+    correct."""
+    n = 200
+    rng = np.random.default_rng(3)
+    types = [T.DOUBLE, T.BIGINT]
+    keys = [float(v) for v in rng.integers(0, 10, size=n)]
+    s1 = [int(v) for v in rng.integers(0, 100, size=n)]
+    aggs = [AggCall("sum", 1, T.BIGINT,
+                    resolve_agg_type("sum", T.BIGINT))]
+    op = HashAggregationOperator(types, [0], aggs, "single",
+                                 hash_grouping=True)
+    page = Page.from_pylists(types, [keys, s1])
+    op.add_input(DevicePage.from_page(page))
+    op.finish()
+    out = op.get_output().to_page()
+    assert op.path_counts["hash"] == 0 and op.path_counts["sort"] > 0
+    assert out.num_rows == 10
+
+
+def test_overflow_falls_back_to_sort_oracle(monkeypatch):
+    """Exact-mode probe-budget overflow must transparently re-group via
+    the sort path with identical results."""
+    from functools import partial
+
+    from trino_tpu.ops import aggregation as agg_mod
+    from trino_tpu.ops import hashtable
+
+    monkeypatch.setattr(
+        agg_mod, "hash_group_ids",
+        partial(hashtable.hash_group_ids, rounds=1))
+    rng = np.random.default_rng(5)
+    n = 900
+    keys = [int(v) for v in np.arange(n) * 7919]  # all distinct
+    s1, s2 = _payload(rng, n)
+    cols = [keys, s1, s2]
+    got = _run_single(AGG_TYPES, cols, [0], AGG_SUITE, True, 256)
+    monkeypatch.undo()
+    want = _run_single(AGG_TYPES, cols, [0], AGG_SUITE, False, 256)
+    _assert_rows_equal(got, want)
+
+
+# ----------------------------------------------------- partial/final chain
+
+
+def _run_partial_final(input_types, columns, group_channels, aggs,
+                       page_rows, adaptive=False, adaptive_min_rows=10**9,
+                       adaptive_ratio=0.9):
+    n = len(columns[0])
+    partial = HashAggregationOperator(
+        input_types, group_channels, aggs, "partial",
+        adaptive_partial=adaptive, adaptive_min_rows=adaptive_min_rows,
+        adaptive_ratio=adaptive_ratio)
+    final_aggs = [AggCall(a.function, None, a.arg_type, a.output_type)
+                  for a in aggs]
+    inter_types = partial._intermediate_types()
+    final = HashAggregationOperator(
+        inter_types, list(range(len(group_channels))), final_aggs, "final")
+    dicts = [Dictionary() if t.is_pooled else None for t in input_types]
+    for lo in range(0, n, page_rows):
+        chunk = [c[lo:lo + page_rows] for c in columns]
+        page = Page.from_pylists(input_types, chunk, dicts)
+        partial.add_input(DevicePage.from_page(page))
+        while True:
+            out = partial.get_output()
+            if out is None:
+                break
+            final.add_input(out)
+    partial.finish()
+    while not partial.is_finished():
+        out = partial.get_output()
+        if out is not None:
+            final.add_input(out)
+    final.finish()
+    pages = []
+    while not final.is_finished():
+        p = final.get_output()
+        if p is not None:
+            pages.append(p.to_page())
+    return partial, _sorted_rows(Page.concat(pages).to_rows())
+
+
+def test_partial_final_hash_matches_single_sort():
+    rng = np.random.default_rng(17)
+    n = 1000
+    keys = [int(v) if rng.random() > 0.2 else None
+            for v in rng.integers(0, 37, size=n)]
+    s1, s2 = _payload(rng, n)
+    cols = [keys, s1, s2]
+    _, got = _run_partial_final(AGG_TYPES, cols, [0], AGG_SUITE, 256)
+    want = _run_single(AGG_TYPES, cols, [0], AGG_SUITE, False)
+    _assert_rows_equal(got, want)
+
+
+def test_adaptive_partial_switches_to_passthrough():
+    """High-cardinality keys: the partial step must observe the
+    non-reducing ratio, switch to pass-through, and final results must
+    be unchanged."""
+    rng = np.random.default_rng(23)
+    n = 1200
+    keys = [int(v) for v in rng.permutation(n * 50)[:n]]  # all distinct
+    s1, s2 = _payload(rng, n)
+    cols = [keys, s1, s2]
+    partial, got = _run_partial_final(
+        AGG_TYPES, cols, [0], AGG_SUITE, 256,
+        adaptive=True, adaptive_min_rows=256, adaptive_ratio=0.5)
+    assert partial.passthrough, "adaptive partial agg must have tripped"
+    assert partial.path_counts["passthrough"] > 0
+    want = _run_single(AGG_TYPES, cols, [0], AGG_SUITE, False)
+    _assert_rows_equal(got, want)
+
+
+def test_adaptive_partial_stays_on_for_reducing_input():
+    rng = np.random.default_rng(29)
+    n = 1200
+    keys = [int(v) for v in rng.integers(0, 4, size=n)]  # 4 groups
+    s1, s2 = _payload(rng, n)
+    cols = [keys, s1, s2]
+    partial, got = _run_partial_final(
+        AGG_TYPES, cols, [0], AGG_SUITE, 256,
+        adaptive=True, adaptive_min_rows=256, adaptive_ratio=0.5)
+    assert not partial.passthrough
+    assert partial.path_counts["passthrough"] == 0
+    want = _run_single(AGG_TYPES, cols, [0], AGG_SUITE, False)
+    _assert_rows_equal(got, want)

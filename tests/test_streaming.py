@@ -51,6 +51,7 @@ def test_streaming_q3_overlaps_and_matches(local):
         f"no stage overlap observed: {overlap}")
 
 
+@pytest.mark.slow  # 3 full distributed queries x 2 modes (~40s)
 def test_streaming_matches_barrier_mode(local):
     for q in (1, 10, 18):
         want = sorted(make_dist(False).execute(TPCH_QUERIES[q]).rows)

@@ -12,6 +12,7 @@ import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence
 
+from .. import jit_stats
 from ..connectors.spi import ConnectorSplit
 from ..ops.operator import Operator, SourceOperator
 
@@ -19,17 +20,23 @@ from ..ops.operator import Operator, SourceOperator
 @dataclass
 class OperatorStats:
     """Per-operator execution stats (reference:
-    operator/OperatorStats.java — wall/cpu nanos, rows/pages in+out)."""
+    operator/OperatorStats.java — wall/cpu nanos, rows/pages in+out).
+    ``compile_count`` is the number of jit traces (XLA cache misses)
+    attributed to this operator's calls: after warmup it must stay flat
+    for same-shape pages — silent retracing is the classic JAX perf
+    bug, and this counter makes it assertable."""
 
     name: str
     output_rows: int = 0
     output_pages: int = 0
     wall_ns: int = 0
+    compile_count: int = 0
 
     def line(self) -> str:
         ms = self.wall_ns / 1e6
         return (f"{self.name}: {self.output_rows} rows, "
-                f"{self.output_pages} pages, {ms:.1f}ms")
+                f"{self.output_pages} pages, {ms:.1f}ms, "
+                f"{self.compile_count} compiles")
 
 
 class Driver:
@@ -74,9 +81,11 @@ class Driver:
             if nxt.needs_input():
                 if self.collect_stats:
                     t0 = time.perf_counter_ns()
+                    c0 = jit_stats.thread_total()
                     page = cur.get_output()
                     st = self.stats[i]
                     st.wall_ns += time.perf_counter_ns() - t0
+                    st.compile_count += jit_stats.thread_total() - c0
                     if page is not None:
                         st.output_pages += 1
                         st.output_rows += page.count()
@@ -85,9 +94,11 @@ class Driver:
                 if page is not None:
                     if self.collect_stats:
                         t0 = time.perf_counter_ns()
+                        c0 = jit_stats.thread_total()
                         nxt.add_input(page)
-                        self.stats[i + 1].wall_ns += \
-                            time.perf_counter_ns() - t0
+                        st1 = self.stats[i + 1]
+                        st1.wall_ns += time.perf_counter_ns() - t0
+                        st1.compile_count += jit_stats.thread_total() - c0
                     else:
                         nxt.add_input(page)
                     moved = True

@@ -17,7 +17,9 @@ from .. import types as T
 from ..block import Page
 from ..expr.compiler import PageProcessor
 from ..expr.ir import Call, InputRef, Literal, RowExpression
-from ..ops.aggregation import AggCall, HashAggregationOperator
+from ..ops.aggregation import (ADAPTIVE_MIN_ROWS,
+                               ADAPTIVE_RATIO_THRESHOLD, AggCall,
+                               HashAggregationOperator)
 from ..ops.join import HashBuilderOperator, JoinBridge, LookupJoinOperator
 from ..ops.operator import (DeferredPagesSourceOperator,
                             EnforceSingleRowOperator, FilterProjectOperator,
@@ -46,6 +48,26 @@ def create_table_idempotent(conn, schema: str, table: str, columns):
         if e.code != "TABLE_ALREADY_EXISTS":
             raise
         return conn.metadata().get_table_handle(schema, table)
+
+
+def grouping_options(props: Dict) -> Dict:
+    """LocalExecutionPlanner grouping kwargs from a raw
+    session-properties mapping, with registered defaults applied — the
+    ONE place the property names map to planner knobs (every runner
+    builds its planners through this, so the sites cannot drift)."""
+    from .. import session_properties as SP
+
+    def v(name):
+        return props.get(name, SP.REGISTRY[name].default)
+
+    return {
+        "hash_grouping": v("hash_grouping_enabled"),
+        "adaptive_partial_agg": v("adaptive_partial_aggregation_enabled"),
+        "adaptive_partial_ratio": v(
+            "adaptive_partial_aggregation_unique_rows_ratio_threshold"),
+        "adaptive_partial_min_rows": v(
+            "adaptive_partial_aggregation_min_rows"),
+    }
 
 
 class PhysicalPipeline:
@@ -87,7 +109,11 @@ class LocalExecutionPlanner:
                  exchange_reader=None, memory_pool=None,
                  join_max_lanes: Optional[int] = None,
                  dynamic_filtering: bool = True,
-                 page_sink_factory=None):
+                 page_sink_factory=None,
+                 hash_grouping: bool = True,
+                 adaptive_partial_agg: bool = True,
+                 adaptive_partial_ratio: float = ADAPTIVE_RATIO_THRESHOLD,
+                 adaptive_partial_min_rows: int = ADAPTIVE_MIN_ROWS):
         self.metadata = metadata
         self.desired_splits = desired_splits
         self.task_id = task_id
@@ -96,6 +122,12 @@ class LocalExecutionPlanner:
         self.memory_pool = memory_pool
         self.join_max_lanes = join_max_lanes
         self.dynamic_filtering = dynamic_filtering
+        #: GROUP BY path: vectorized open-addressing hash table (default)
+        #: vs sort-based oracle (``hash_grouping_enabled`` session prop)
+        self.hash_grouping = hash_grouping
+        self.adaptive_partial_agg = adaptive_partial_agg
+        self.adaptive_partial_ratio = adaptive_partial_ratio
+        self.adaptive_partial_min_rows = adaptive_partial_min_rows
         #: override for write sinks: ``factory(TableWriterNode) -> sink``
         #: — the multi-process runtime routes worker writes to the
         #: coordinator's catalog through this (page-sink RPC)
@@ -323,9 +355,13 @@ class LocalExecutionPlanner:
                 types_ = [types_[c] for c in want]
                 layout = {s.name: i for i, s in enumerate(in_syms)}
                 group_channels = list(range(len(node.group_keys)))
-        op = HashAggregationOperator(types_, group_channels, aggs,
-                                     step=node.step,
-                                     memory_context=self._mem_ctx("agg"))
+        op = HashAggregationOperator(
+            types_, group_channels, aggs, step=node.step,
+            memory_context=self._mem_ctx("agg"),
+            hash_grouping=self.hash_grouping,
+            adaptive_partial=self.adaptive_partial_agg,
+            adaptive_ratio=self.adaptive_partial_ratio,
+            adaptive_min_rows=self.adaptive_partial_min_rows)
         ops.append(op)
         new_layout = {}
         out_types = []
@@ -348,7 +384,8 @@ class LocalExecutionPlanner:
         order = sorted(layout.items(), key=lambda kv: kv[1])
         op = HashAggregationOperator(
             types_, [ch for _, ch in order], [],
-            memory_context=self._mem_ctx("distinct"))
+            memory_context=self._mem_ctx("distinct"),
+            hash_grouping=self.hash_grouping)
         ops.append(op)
         new_layout = {name: i for i, (name, _) in enumerate(order)}
         return ops, new_layout, types_
@@ -521,7 +558,8 @@ class LocalExecutionPlanner:
         # order, i.e. channel j <-> left.output_symbols[j] <-> symbols[j]
         pops.append(HashAggregationOperator(
             ptypes, pchans, [],
-            memory_context=self._mem_ctx("setop-distinct")))
+            memory_context=self._mem_ctx("setop-distinct"),
+            hash_grouping=self.hash_grouping))
         layout = {s.name: j for j, s in enumerate(node.symbols)}
         out_types = [ptypes[ch] for ch in pchans]
         return pops, layout, out_types

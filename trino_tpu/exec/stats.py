@@ -38,7 +38,8 @@ class TaskStatsTree:
             "operators": [
                 {"name": o.name, "rows": o.output_rows,
                  "pages": o.output_pages,
-                 "wall_ms": round(o.wall_ns / 1e6, 2)}
+                 "wall_ms": round(o.wall_ns / 1e6, 2),
+                 "compiles": o.compile_count}
                 for o in self.operators],
         }
 
@@ -97,11 +98,13 @@ class QueryStatsTree:
                     a = agg.get(i)
                     if a is None:
                         agg[i] = OperatorStats(o.name, o.output_rows,
-                                               o.output_pages, o.wall_ns)
+                                               o.output_pages, o.wall_ns,
+                                               o.compile_count)
                     else:
                         a.output_rows += o.output_rows
                         a.output_pages += o.output_pages
                         a.wall_ns += o.wall_ns
+                        a.compile_count += o.compile_count
             for i in sorted(agg):
                 lines.append("    " + agg[i].line())
             for t in s.tasks:

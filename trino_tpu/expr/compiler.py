@@ -813,6 +813,9 @@ class PageProcessor:
         return tuple(luts)
 
     def _run(self, cols, nulls, valid, luts):
+        from .. import jit_stats
+
+        jit_stats.bump("page_processor")  # trace-time only (cache miss)
         env = {"cols": cols, "nulls": nulls, "luts": luts}
         new_valid = valid
         if self._filter_plan is not None:

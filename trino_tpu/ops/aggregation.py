@@ -5,19 +5,35 @@ Reference analog: ``operator/HashAggregationOperator.java`` +
 putIfAbsent) + the bytecode-compiled accumulators
 (``operator/aggregation/AccumulatorCompiler.java``).
 
-TPU redesign: instead of scatter-heavy open addressing (XLA scatter is
-slow), grouping is **sort-based**: normalize key columns to (null-bit,
-uint64) operand pairs, ``lax.sort`` the whole batch lexicographically
-(XLA's native multi-operand sort, MXU/VPU friendly), detect group
-boundaries by adjacent-row comparison, assign dense group ids with a
-cumsum, and reduce states with ``jax.ops.segment_sum/min/max`` — all
-static-shape, fully fused by XLA.
+Grouping runs one of two paths:
+
+- **hash** (default): the vectorized open-addressing table of
+  ``ops/hashtable.py`` assigns each row a dense group id via bounded
+  linear-probe rounds of masked scatter/gather — no sort, and state
+  columns never ride through comparator operands. The segment reduce
+  then runs over the hash-assigned gids (one cheap gid-only sort first
+  when the Pallas TPU kernel — which requires sorted segments — is
+  active). Float grouping keys and probe-budget overflow fall back to:
+- **sort** (oracle/fallback): normalize key columns to (null-bit,
+  uint64) operand pairs, ``lax.sort`` the batch lexicographically,
+  detect group boundaries by adjacent-row comparison, cumsum dense
+  group ids, segment-reduce. Forceable via the ``hash_grouping_enabled``
+  session property for cross-checking.
 
 Streaming: each input page is partially aggregated on device (bounded
 output = its own row count), partials accumulate; ``finish`` re-groups the
 concatenated partials and applies final projections. This mirrors the
 reference's partial/final adapter split and keeps memory proportional to
 groups, not input rows.
+
+**Adaptive partial aggregation** (reference:
+``adaptive_partial_aggregation_enabled``; "Partial Partial Aggregates",
+PAPERS.md): a partial-step operator observes its groups-to-rows
+reduction ratio; once enough rows show grouping is not reducing
+(ratio above threshold), it stops aggregating and passes pages through
+in the intermediate keys+states layout — the final step re-groups, so
+results are unchanged while the partial stops burning time on
+high-cardinality keys.
 """
 
 from __future__ import annotations
@@ -30,11 +46,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import jit_stats
 from .. import types as T
 from ..block import DevicePage, padded_size
 from ..types import TypeError_
+from .hashtable import (hash_group_ids, hash_segment_reduce,
+                        hashable_key_types)
 from .operator import Operator
 from .sortkeys import group_operands
+
+#: adaptive partial aggregation: minimum observed input rows before the
+#: reduction ratio is trusted (reference default: 100k rows)
+ADAPTIVE_MIN_ROWS = 100_000
+#: groups/rows ratio above which the partial step stops aggregating
+ADAPTIVE_RATIO_THRESHOLD = 0.9
 
 
 # ---------------------------------------------------------------------------
@@ -326,6 +351,7 @@ def _group_reduce(key_ops: Tuple, key_raws: Tuple, state_cols: Tuple,
     state_cols: per-row state columns (carried through the sort)
     Returns (group_key_raws, group_key_nullbits, reduced_states, out_valid).
     """
+    jit_stats.bump("sort_group_reduce")
     cap = valid.shape[0]
     # invalid lanes sort last: leading operand = ~valid
     operands = [(~valid).astype(jnp.uint8)] + list(key_ops) \
@@ -382,12 +408,29 @@ class HashAggregationOperator(Operator):
     def __init__(self, input_types: Sequence[T.Type],
                  group_channels: Sequence[int],
                  aggregates: Sequence[AggCall], step: str = "single",
-                 memory_context=None):
+                 memory_context=None, hash_grouping: bool = True,
+                 adaptive_partial: bool = True,
+                 adaptive_ratio: float = ADAPTIVE_RATIO_THRESHOLD,
+                 adaptive_min_rows: int = ADAPTIVE_MIN_ROWS):
         assert step in ("single", "partial", "final")
         self.input_types = list(input_types)
         self.group_channels = list(group_channels)
         self.aggregates = list(aggregates)
         self.step = step
+        self.hash_grouping = hash_grouping
+        self.adaptive_partial = adaptive_partial and step == "partial"
+        self.adaptive_ratio = adaptive_ratio
+        self.adaptive_min_rows = adaptive_min_rows
+        #: adaptive observation window (hash path only: the group count
+        #: is already on host from the per-page stats fetch)
+        self._adaptive_rows = 0
+        self._adaptive_groups = 0
+        self._adaptive_decided = False
+        #: True once the partial step switched to pass-through
+        self.passthrough = False
+        self._pending: List[DevicePage] = []  # pass-through output queue
+        #: pages grouped per path, for EXPLAIN/observability
+        self.path_counts = {"hash": 0, "sort": 0, "passthrough": 0}
         self._partials: List = []  # DevicePage | SpilledPage entries
         self._emitted = False
         self._done = False
@@ -445,6 +488,12 @@ class HashAggregationOperator(Operator):
                                 "across pages; exchange must unify pools")
                         self._state_dicts[k] = d
                 k += 1
+        if self.passthrough:
+            # adaptive partial aggregation tripped: emit the page in the
+            # intermediate keys+states layout without grouping at all
+            self.path_counts["passthrough"] += 1
+            self._pending.append(self._passthrough_page(page))
+            return
         partial = self._aggregate_page(page, intermediate=intermediate)
         if self._ctx is None:
             self._partials.append(partial)
@@ -512,20 +561,24 @@ class HashAggregationOperator(Operator):
 
         from .pallas_kernels import pallas_mode
 
-        out_keys, out_key_nulls, reduced, out_valid = _group_reduce(
-            tuple(key_ops), tuple(key_raws), tuple(state_cols), page.valid,
-            num_keys=len(self.group_channels),
-            num_states=len(state_cols), kinds=self._kinds,
-            pallas=pallas_mode())
+        mode = pallas_mode()
+        result = None
+        if self.hash_grouping and hashable_key_types(key_types):
+            result = self._hash_group_page(page, key_ops, key_raws,
+                                           key_channels, state_cols, mode,
+                                           observe=not intermediate)
+        if result is None:
+            self.path_counts["sort"] += 1
+            result = _group_reduce(
+                tuple(key_ops), tuple(key_raws), tuple(state_cols),
+                page.valid, num_keys=len(self.group_channels),
+                num_states=len(state_cols), kinds=self._kinds,
+                pallas=mode)
+        out_keys, out_key_nulls, reduced, out_valid = result
 
         # string min/max: reduced RANK -> representative CODE in the
         # captured pool (dead/sentinel lanes clamp; count==0 nulls them)
-        reduced = list(reduced)
-        for k, is_str in enumerate(self._str_state):
-            if is_str:
-                _, inv = _rank_and_inverse(self._state_dicts[k])
-                r = jnp.clip(reduced[k], 0, len(inv) - 1)
-                reduced[k] = jnp.asarray(inv)[r].astype(jnp.int32)
+        reduced = self._states_rank_to_code(list(reduced))
 
         cols, nulls = list(out_keys), [jnp.asarray(n) for n in out_key_nulls]
         for r in reduced:
@@ -537,6 +590,78 @@ class HashAggregationOperator(Operator):
             for k in range(len(self._str_state))]
         return DevicePage(types, cols, nulls, out_valid, dicts)
 
+    def _hash_group_page(self, page: DevicePage, key_ops, key_raws,
+                         key_channels, state_cols, mode: str,
+                         observe: bool):
+        """Hash-path grouping of one page; None => the caller falls
+        back to the sort oracle (probe-budget overflow)."""
+        exact = self.step != "partial"
+        gid, group_rows, ngroups, overflow = hash_group_ids(
+            tuple(key_ops), page.valid, exact=exact)
+        key_nulls = tuple(page.nulls[c] for c in key_channels)
+        # dispatch the reduce SPECULATIVELY, before the overflow sync:
+        # the device chews on it while the host waits on the scalar, and
+        # the (astronomically rare) overflow page just wastes one launch
+        result = hash_segment_reduce(gid, group_rows, ngroups,
+                                     tuple(key_raws), key_nulls,
+                                     tuple(state_cols), self._kinds,
+                                     pallas=mode)
+        if exact:
+            if bool(overflow):
+                return None
+        elif observe and self.adaptive_partial \
+                and not self._adaptive_decided:
+            self._observe_reduction(page.valid, ngroups)
+        self.path_counts["hash"] += 1
+        return result
+
+    def _states_rank_to_code(self, state_cols: List) -> List:
+        """String min/max value states: lexicographic RANK -> the
+        representative CODE in the captured pool (the intermediate-page
+        wire contract). Dead/sentinel lanes clamp into range; their
+        count state of 0 nulls them downstream."""
+        for k, is_str in enumerate(self._str_state):
+            if is_str:
+                _, inv = _rank_and_inverse(self._state_dicts[k])
+                r = jnp.clip(state_cols[k], 0, len(inv) - 1)
+                state_cols[k] = jnp.asarray(inv)[r].astype(jnp.int32)
+        return state_cols
+
+    def _observe_reduction(self, valid, ngroups):
+        """Accumulate the groups/rows ratio; once enough rows show
+        grouping is not reducing, switch to pass-through (reference:
+        AggregationOperator's adaptive partial aggregation)."""
+        stats = np.asarray(jnp.stack(
+            [ngroups, jnp.sum(valid.astype(jnp.int32))]))
+        self._adaptive_groups += int(stats[0])
+        self._adaptive_rows += int(stats[1])
+        if self._adaptive_rows >= self.adaptive_min_rows:
+            self._adaptive_decided = True
+            ratio = self._adaptive_groups / max(self._adaptive_rows, 1)
+            if ratio > self.adaptive_ratio:
+                self.passthrough = True
+
+    def _passthrough_page(self, page: DevicePage) -> DevicePage:
+        """Raw input page -> intermediate keys+states layout, ungrouped
+        (every row its own group; the final step re-groups, so results
+        are unchanged — partial aggregation is only a reduction)."""
+        state_cols: List = []
+        for a in self.aggregates:
+            state_cols.extend(_init_states(a, page.cols, page.nulls,
+                                           page.valid, page.dictionaries))
+        # string min/max states travel as CODES (same wire contract as
+        # the reduced path): map rank values back through the pool
+        state_cols = self._states_rank_to_code(state_cols)
+        cols = [page.cols[c] for c in self.group_channels]
+        nulls = [page.nulls[c] for c in self.group_channels]
+        no_nulls = jnp.zeros(page.capacity, dtype=bool)
+        for s in state_cols:
+            cols.append(s)
+            nulls.append(no_nulls)
+        dicts = list(self._group_dicts) + self._state_dict_tail()
+        return DevicePage(self._intermediate_types(), cols, nulls,
+                          page.valid, dicts)
+
     def _intermediate_types(self) -> List[T.Type]:
         keys = [self.input_types[c] for c in self.group_channels]
         states: List[T.Type] = []
@@ -545,6 +670,8 @@ class HashAggregationOperator(Operator):
         return keys + states
 
     def get_output(self) -> Optional[DevicePage]:
+        if self._pending:
+            return self._pending.pop(0)
         if not self._finishing or self._emitted:
             return None
         self._emitted = True

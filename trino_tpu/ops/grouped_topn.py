@@ -39,6 +39,9 @@ from .sortkeys import SortKey, group_operands, sort_operands
 def _topn_kernel(part_ops, order_ops, cols, nulls, valid,
                  n_part: int, n_order: int, ranking: str,
                  max_rank: int, ncols: int):
+    from .. import jit_stats
+
+    jit_stats.bump("grouped_topn_kernel")
     n = valid.shape[0]
     operands = [(~valid).astype(jnp.uint8)] + list(part_ops) \
         + list(order_ops) + list(cols) + list(nulls) + [valid]

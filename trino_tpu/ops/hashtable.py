@@ -1,0 +1,205 @@
+"""Vectorized open-addressing GroupByHash primitive.
+
+Reference analog: ``operator/MultiChannelGroupByHash.java`` (the
+putIfAbsent loop assigning dense group ids) — redesigned as a fully
+vectorized page-at-a-time kernel instead of a row-at-a-time loop, the
+hash-based plan shape of "Global Hash Tables Strike Back!" (PAPERS.md).
+
+Design:
+  - keys arrive as the engine's normalized grouping operands
+    (``ops/sortkeys.group_operands``: a (tag_u8, u64) pair per key
+    column) — all integer lanes, so one splitmix64 mix per operand
+    yields the bucket hash;
+  - the table is ``2 * capacity`` slots (power of two, load factor
+    <= 0.5) storing the REPRESENTATIVE ROW INDEX of the group that owns
+    each slot (``capacity`` = empty sentinel), plus one dummy slot that
+    absorbs masked scatters;
+  - insert-or-lookup runs a bounded number of linear-probe ROUNDS, each
+    round fully vectorized over the page: every unresolved row probes
+    ``(h + round) & mask``, empty slots are claimed by scatter-min on
+    row index, claimants re-gather the installed owner and compare full
+    keys by gathering the owner row's operands — equal keys join the
+    owner's group, colliders advance to the next probe;
+  - dense group ids are a cumsum over "row owns itself" leaders, so gid
+    order is first-occurrence order (matching the reference's
+    putIfAbsent numbering), with no sort anywhere.
+
+Rows still unresolved after the probe budget either overflow (exact
+mode: the caller falls back to the sort-based oracle) or become
+singleton groups (partial aggregation tolerates duplicate groups — the
+final step re-groups, per "Partial Partial Aggregates", PAPERS.md).
+
+Float keys are NOT hashed here: the TPU x64 rewriter cannot bitcast
+f64<->u64 (see ops/sortkeys.py), so float grouping keys keep the
+sort-based path. ``hashable_key_types`` is the gate.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import jit_stats
+from .. import types as T
+
+#: linear-probe rounds per page: with load factor <= 0.5 and a 64-bit
+#: mixed hash, an unresolved row after 32 probes is astronomically rare
+#: for non-adversarial input; adversarial input falls back / singles out.
+PROBE_ROUNDS = 32
+
+_M1 = np.uint64(0xBF58476D1CE4E5B9)
+_M2 = np.uint64(0x94D049BB133111EB)
+_M3 = np.uint64(0x9E3779B97F4A7C15)  # golden-ratio increment
+
+
+def hashable_key_types(key_types: Sequence[T.Type]) -> bool:
+    """True when every grouping key can take the hash path (integer
+    operands only — floats keep the sort path, see module docstring)."""
+    return all(t not in (T.DOUBLE, T.REAL) for t in key_types)
+
+
+def splitmix64(x):
+    """The splitmix64 finalizer over uint64 lanes (public-domain
+    constant set; also the reference's XxHash-style mixing role)."""
+    x = (x + _M3).astype(jnp.uint64)
+    x = (x ^ (x >> np.uint64(30))) * _M1
+    x = (x ^ (x >> np.uint64(27))) * _M2
+    return x ^ (x >> np.uint64(31))
+
+
+def _mix_operands(key_ops: Tuple, n: int):
+    """Combine the flattened (tag, key) operand columns into one 64-bit
+    hash per row. Zero key columns (global aggregation) hash to 0."""
+    h = jnp.zeros((n,), dtype=jnp.uint64)
+    for op in key_ops:
+        h = splitmix64(h ^ op.astype(jnp.uint64))
+    return h
+
+
+@partial(jax.jit, static_argnames=("rounds", "exact"))
+def hash_group_ids(key_ops: Tuple, valid, rounds: int = PROBE_ROUNDS,
+                   exact: bool = True):
+    """Vectorized insert-or-lookup over one page.
+
+    key_ops: flattened (tag_u8, u64) grouping operands (integer dtypes).
+    valid:   bool lane mask; invalid lanes get the dump gid ``capacity``.
+
+    Returns (gid, group_rows, ngroups, overflow):
+      gid        int32 (cap,)   dense group id per row, first-occurrence
+                                order; invalid lanes get ``cap``
+      group_rows int32 (cap,)   representative row index per group id
+      ngroups    int32 scalar   number of groups assigned
+      overflow   bool scalar    exact mode only: some row exhausted its
+                                probe budget and NO gid is trustworthy
+                                (caller must fall back). In non-exact
+                                mode always False: unresolved rows become
+                                their own singleton groups.
+    """
+    jit_stats.bump("hash_group_ids")
+    cap = valid.shape[0]
+    # 2x capacity rounded up to a power of two (pages are pow2-padded
+    # already; defend against odd capacities so the & mask stays sound)
+    tsize = 1 << max(2 * cap - 1, 1).bit_length()
+    mask = np.uint64(tsize - 1)
+    row_idx = jnp.arange(cap, dtype=jnp.int32)
+
+    h = _mix_operands(key_ops, cap)
+    slot0 = (h & mask).astype(jnp.int32)
+
+    # slot -> owning row index; ``cap`` = empty; slot ``tsize`` is the
+    # dummy that absorbs scatters from masked-off lanes
+    table0 = jnp.full((tsize + 1,), cap, dtype=jnp.int32)
+    rep0 = jnp.where(valid, cap, row_idx)  # resolved rows' owner row
+    resolved0 = ~valid
+
+    def probe_round(carry):
+        r, table, rep, resolved = carry
+        active = ~resolved
+        slot = jnp.where(active, (slot0 + r) & (tsize - 1), tsize)
+        owner = table[slot]
+        empty = active & (owner == cap)
+        # claim empty slots: smallest probing row index wins the install
+        claim = jnp.full((tsize + 1,), cap, dtype=jnp.int32)
+        claim = claim.at[jnp.where(empty, slot, tsize)].min(row_idx)
+        winner = empty & (claim[slot] == row_idx)
+        table = table.at[jnp.where(winner, slot, tsize)].set(row_idx)
+        owner = table[slot]
+        # full-key compare against the (possibly just-installed) owner
+        owner_safe = jnp.clip(owner, 0, cap - 1)
+        eq = active & (owner < cap)
+        for op in key_ops:
+            eq = eq & (op == op[owner_safe])
+        rep = jnp.where(eq, owner, rep)
+        return r + 1, table, rep, resolved | eq
+
+    def keep_probing(carry):
+        r, _table, _rep, resolved = carry
+        return (r < rounds) & jnp.any(~resolved)
+
+    # typical pages resolve in 1-3 rounds; the loop exits as soon as
+    # every row found its group, paying the full budget only under
+    # adversarial collision chains
+    _, _, rep, resolved = jax.lax.while_loop(
+        keep_probing, probe_round,
+        (jnp.zeros((), dtype=jnp.int32), table0, rep0, resolved0))
+
+    unresolved = ~resolved
+    if exact:
+        overflow = jnp.any(unresolved)
+    else:
+        # partial aggregation tolerates duplicate groups: unresolved
+        # rows lead their own singleton group
+        rep = jnp.where(unresolved, row_idx, rep)
+        overflow = jnp.zeros((), dtype=bool)
+
+    leader = valid & (rep == row_idx)
+    prefix = jnp.cumsum(leader.astype(jnp.int32)) - 1  # leader gid
+    rep_safe = jnp.clip(rep, 0, cap - 1)
+    gid = jnp.where(valid & (rep < cap), prefix[rep_safe], cap)
+    ngroups = jnp.sum(leader.astype(jnp.int32))
+    group_rows = jnp.zeros((cap + 1,), dtype=jnp.int32)
+    group_rows = group_rows.at[jnp.where(leader, prefix, cap)].set(row_idx)
+    return gid, group_rows[:cap], ngroups, overflow
+
+
+@partial(jax.jit, static_argnames=("kinds", "pallas"))
+def hash_segment_reduce(gid, group_rows, ngroups, key_raws: Tuple,
+                        key_nulls: Tuple, state_cols: Tuple, kinds: Tuple,
+                        pallas: str = ""):
+    """Reduce state columns by hash-assigned gid and gather group keys.
+
+    The Pallas segment kernel requires non-decreasing gids (steps <= 1),
+    so when it is active the states take one cheap single-operand sort
+    on the int32 gid — still far lighter than the sort path's
+    full (1 + 2k)-operand key sort dragging raw keys along. Off-TPU,
+    ``jax.ops.segment_*`` handles unsorted gids directly and no sort
+    runs at all.
+
+    Returns (group_key_raws, group_key_nulls, reduced_states, out_valid)
+    in the exact shape contract of ``aggregation._group_reduce``.
+    """
+    jit_stats.bump("hash_segment_reduce")
+    from .pallas_kernels import segment_reduce
+
+    cap = gid.shape[0]
+    if pallas and state_cols:
+        ops = [gid] + list(state_cols)
+        sorted_ = jax.lax.sort(ops, num_keys=1, is_stable=False)
+        r_gid, r_states = sorted_[0], sorted_[1:]
+    else:
+        r_gid, r_states = gid, state_cols
+    reduced = []
+    for kind, col in zip(kinds, r_states):
+        r = segment_reduce(col, r_gid, num_segments=cap + 1, kind=kind,
+                           mode=pallas)
+        reduced.append(r[:cap])
+
+    out_valid = jnp.arange(cap, dtype=jnp.int32) < ngroups
+    safe_idx = jnp.where(out_valid, group_rows, 0)
+    out_key_raws = tuple(kr[safe_idx] for kr in key_raws)
+    out_key_nulls = tuple(kn[safe_idx] & out_valid for kn in key_nulls)
+    return out_key_raws, out_key_nulls, tuple(reduced), out_valid

@@ -101,6 +101,9 @@ def _build_sorted(key_u64, anynull, cols, nulls, valid):
     """Sort the build rows by key; null-key or invalid lanes sort last.
     ``valid`` rides along so FULL OUTER can emit unmatched build rows
     (including null-key rows, which are never ``usable``)."""
+    from .. import jit_stats
+
+    jit_stats.bump("join_build_sorted")
     usable = valid & ~anynull if anynull is not None else valid
     sort_key = jnp.where(usable, key_u64, np.uint64(0xFFFFFFFFFFFFFFFF))
     operands = [sort_key, usable, valid] + list(cols) + list(nulls)
@@ -111,6 +114,9 @@ def _build_sorted(key_u64, anynull, cols, nulls, valid):
 
 @jax.jit
 def _probe_counts(build_keys, build_usable, probe_keys, probe_usable):
+    from .. import jit_stats
+
+    jit_stats.bump("join_probe_counts")
     lo = jnp.searchsorted(build_keys, probe_keys, side="left")
     hi = jnp.searchsorted(build_keys, probe_keys, side="right")
     count = jnp.where(probe_usable, hi - lo, 0)
@@ -120,6 +126,9 @@ def _probe_counts(build_keys, build_usable, probe_keys, probe_usable):
 @partial(jax.jit, static_argnames=("out_cap",))
 def _expand_matches(lo, count, out_cap: int):
     """Candidate pairs: output lane j -> (probe_row, build_row)."""
+    from .. import jit_stats
+
+    jit_stats.bump("join_expand_matches")
     off_end = jnp.cumsum(count)
     total = off_end[-1]
     j = jnp.arange(out_cap, dtype=jnp.int64)

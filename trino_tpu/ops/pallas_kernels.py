@@ -161,6 +161,9 @@ def _kernel(starts_ref, col_ref, gid_ref, out_ref, *, kind: str,
                    static_argnames=("num_segments", "kind", "interpret"))
 def _segment_reduce_pallas(col, gid, num_segments: int, kind: str,
                            interpret: bool):
+    from .. import jit_stats
+
+    jit_stats.bump("segment_reduce_pallas")
     n = col.shape[0]
     dtype = str(col.dtype)
     ident = _IDENTITY[(kind, dtype)]

@@ -28,6 +28,9 @@ from .sortkeys import SortKey, sort_operands
 @partial(jax.jit, static_argnames=("num_key_ops",))
 def _sorted_by(key_ops, cols, nulls, valid, num_key_ops: int):
     """Sort carrying all columns; invalid lanes last."""
+    from .. import jit_stats
+
+    jit_stats.bump("sort_by")
     operands = [(~valid).astype(jnp.uint8)] + list(key_ops) + list(cols) \
         + list(nulls) + [valid]
     s = jax.lax.sort(operands, num_keys=1 + num_key_ops, is_stable=True)

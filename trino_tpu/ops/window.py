@@ -139,6 +139,9 @@ def _window_kernel(part_ops, order_ops, cols, nulls, valid,
                    calls: Tuple[WindowCall, ...]):
     """Sort + compute all window outputs. Returns sorted (cols, nulls,
     valid) + per-call (raw, null) output columns."""
+    from .. import jit_stats
+
+    jit_stats.bump("window_kernel")
     n = valid.shape[0]
     operands = [(~valid).astype(jnp.uint8)] + list(part_ops) \
         + list(order_ops) + list(cols) + list(nulls) + [valid]

@@ -32,13 +32,13 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .. import types as T
 from ..block import DevicePage, Dictionary, padded_size
 from .exchange import (hash_partition_ids, key_to_u64, repartition_a2a,
-                       string_hash_lut)
+                       shard_map, string_hash_lut)
 
 
 def device_exchange_supported(types_: Sequence[T.Type]) -> bool:

@@ -20,7 +20,8 @@ from .. import session_properties as SP
 from .. import types as T
 from ..block import Page
 from ..connectors.spi import Connector
-from ..exec.local_planner import LocalExecutionPlanner, PhysicalPipeline
+from ..exec.local_planner import (LocalExecutionPlanner,
+                                  PhysicalPipeline, grouping_options)
 from ..ops.output import OutputBuffer, PartitionedOutputOperator
 from ..planner.exchanges import add_exchanges
 from ..planner.fragmenter import PlanFragment, fragment_plan, fragments_str
@@ -327,7 +328,8 @@ class DistributedQueryRunner:
             join_max_lanes=SP.value(self.session,
                                     "join_max_expand_lanes"),
             dynamic_filtering=SP.value(
-                self.session, "enable_dynamic_filtering"))
+                self.session, "enable_dynamic_filtering"),
+            **grouping_options(self.session.properties))
         collect = getattr(self, "_collect_stats", False)
         task = TaskStatsTree(t)
         if root is not None:

@@ -29,6 +29,31 @@ import numpy as np
 
 from .. import types as T
 
+try:  # jax >= 0.4.35 exports shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # older jax: the experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# newer jax spells the replication-check kwarg ``check_vma``, older
+# releases ``check_rep``; detect once instead of catching TypeError at
+# call time (which would mask unrelated argument mistakes)
+import inspect as _inspect
+
+_SM_PARAMS = _inspect.signature(_shard_map).parameters
+_SM_CHECK_KW = ("check_vma" if "check_vma" in _SM_PARAMS
+                else "check_rep" if "check_rep" in _SM_PARAMS else None)
+
+
+def shard_map(*args, **kwargs):
+    """Version-compat ``shard_map``: call sites write ``check_vma``;
+    the shim renames (or drops) it to whatever this jax supports."""
+    if "check_vma" in kwargs and _SM_CHECK_KW != "check_vma":
+        kwargs = dict(kwargs)
+        val = kwargs.pop("check_vma")
+        if _SM_CHECK_KW is not None:
+            kwargs[_SM_CHECK_KW] = val
+    return _shard_map(*args, **kwargs)
+
 
 def string_hash_lut(d) -> np.ndarray:
     """code -> stable value hash (crc32): equal strings route equally

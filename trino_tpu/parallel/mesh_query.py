@@ -26,14 +26,14 @@ from typing import List, Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..block import Block, Page, padded_size
 from ..ops.aggregation import (_final_project, _group_reduce, _merge_states,
                                _state_plan)
 from ..ops.sortkeys import group_operands
-from .exchange import hash_partition_ids, repartition_a2a
+from .exchange import (hash_partition_ids, repartition_a2a,
+                       shard_map)
 
 
 def _shard_page(page: Page, n_shards: int):

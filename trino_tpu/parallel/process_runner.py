@@ -501,7 +501,8 @@ class ProcessQueryRunner:
     def _run_output_streaming(self, frag: PlanFragment, root,
                               locations: Dict[int, dict]) -> List[Page]:
         from ..exec.driver import Driver
-        from ..exec.local_planner import LocalExecutionPlanner
+        from ..exec.local_planner import (LocalExecutionPlanner,
+                                          grouping_options)
         from ..planner.plan import OutputNode
         from .remote_exchange import (ExchangeConnectionLost,
                                       RemoteExchangeChannel,
@@ -523,7 +524,8 @@ class ProcessQueryRunner:
 
         planner = LocalExecutionPlanner(
             self.metadata, self.desired_splits, task_id=0, task_count=1,
-            exchange_reader=exchange_reader)
+            exchange_reader=exchange_reader,
+            **grouping_options(self.session.properties))
         abort = threading.Event()
         try:
             plan = planner.plan(OutputNode(frag.root, root.column_names,
@@ -674,7 +676,8 @@ class ProcessQueryRunner:
                              locations: Dict[int, dict]) -> List[Page]:
         """The root (single) fragment runs in the coordinator, pulling
         from workers — the reference's coordinator-only output stage."""
-        from ..exec.local_planner import LocalExecutionPlanner
+        from ..exec.local_planner import (LocalExecutionPlanner,
+                                          grouping_options)
         from ..planner.plan import OutputNode
 
         def exchange_reader(fragment_id: int, kind: str):
@@ -713,7 +716,8 @@ class ProcessQueryRunner:
 
         planner = LocalExecutionPlanner(
             self.metadata, self.desired_splits, task_id=0, task_count=1,
-            exchange_reader=exchange_reader)
+            exchange_reader=exchange_reader,
+            **grouping_options(self.session.properties))
         try:
             plan = planner.plan(OutputNode(frag.root, root.column_names,
                                            root.outputs))

@@ -303,6 +303,7 @@ class WorkerServer:
                           streaming: bool = False) -> int:
         from ..exec.driver import Driver
         from ..exec.local_planner import (LocalExecutionPlanner,
+                                          grouping_options,
                                           PhysicalPipeline,
                                           project_to_wire_layout)
         from ..exec.serde import PageDeserializer
@@ -377,7 +378,8 @@ class WorkerServer:
             join_max_lanes=session_props.get("join_max_expand_lanes"),
             dynamic_filtering=session_props.get(
                 "enable_dynamic_filtering", True),
-            page_sink_factory=self._sink_factory(req))
+            page_sink_factory=self._sink_factory(req),
+            **grouping_options(session_props))
 
         ops, layout, types_ = planner.visit(frag.root)
         ops, layout, types_, key_channels = project_to_wire_layout(

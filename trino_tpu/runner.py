@@ -15,7 +15,8 @@ from typing import Dict, List, Optional, Sequence
 from . import types as T
 from .block import Page
 from .connectors.spi import Connector
-from .exec.local_planner import LocalExecutionPlanner
+from .exec.local_planner import (LocalExecutionPlanner,
+                                 grouping_options)
 from .planner.logical_planner import LogicalPlanner, Metadata
 from .planner.optimizer import optimize
 from .planner.plan import OutputNode, plan_tree_str
@@ -234,7 +235,8 @@ class LocalQueryRunner:
             memory_pool=pool_from_session(self.session),
             join_max_lanes=self._join_lanes(),
             dynamic_filtering=SP.value(self.session,
-                                       "enable_dynamic_filtering"))
+                                       "enable_dynamic_filtering"),
+            **grouping_options(self.session.properties))
 
     def _explain_analyze(self, stmt: ast.Statement) -> QueryResult:
         """Run the query collecting per-operator stats, render the plan

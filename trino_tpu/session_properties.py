@@ -91,6 +91,41 @@ register(SessionProperty(
     "tasks retry from spool WITHOUT re-running producer stages)",
     lambda v: v in ("NONE", "QUERY", "TASK")))
 register(SessionProperty(
+    "hash_grouping_enabled", "boolean", True,
+    "GROUP BY via the vectorized open-addressing hash table "
+    "(ops/hashtable.py): dense group ids without sorting key and state "
+    "columns through lax.sort. Off = sort-based grouping everywhere "
+    "(the correctness oracle). Float grouping keys and probe-budget "
+    "overflow always take the sort path"))
+register(SessionProperty(
+    "adaptive_partial_aggregation_enabled", "boolean", True,
+    "Partial aggregation observes its groups/rows reduction ratio and "
+    "switches to pass-through when grouping stops reducing rows "
+    "(high-cardinality keys); the final step re-groups, results are "
+    "unchanged"))
+def _agg_default(name: str):
+    """Adaptive-partial defaults live in ops/aggregation.py (the operator
+    can be built directly, without a session); the registry re-exports
+    them so the two paths cannot drift. Lazy import: this module loads
+    before jax-heavy ops in some entry points."""
+    from .ops import aggregation
+
+    return getattr(aggregation, name)
+
+
+register(SessionProperty(
+    "adaptive_partial_aggregation_unique_rows_ratio_threshold",
+    "double", _agg_default("ADAPTIVE_RATIO_THRESHOLD"),
+    "Observed unique-groups-to-input-rows ratio above which the "
+    "partial aggregation step stops aggregating",
+    lambda v: 0 < v <= 1))
+register(SessionProperty(
+    "adaptive_partial_aggregation_min_rows", "integer",
+    _agg_default("ADAPTIVE_MIN_ROWS"),
+    "Input rows a partial aggregation must observe before its "
+    "reduction ratio is trusted",
+    lambda v: v >= 1))
+register(SessionProperty(
     "device_exchange", "boolean", True,
     "Run hash exchanges between co-resident stages as an all_to_all "
     "device collective over the mesh (falls back to the host path when "
