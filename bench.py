@@ -66,6 +66,25 @@ def _measure_child():
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           "/tmp/trino_tpu_jax_cache")
     t0 = time.time()
+
+    # backend-init watchdog: with the axon tunnel down, `import jax` /
+    # `jax.devices()` can hang FOREVER (round 5 burned the entire 380 s
+    # TPU budget exactly there). Fail fast with a distinct exit code so
+    # the parent's respawn logic gets a second attempt while the budget
+    # is still mostly intact. Armed BEFORE import (the axon
+    # sitecustomize initializes jax at interpreter startup).
+    init_timeout = float(os.environ.get("BENCH_INIT_TIMEOUT", "75"))
+    init_done = threading.Event()
+
+    def _init_watchdog():
+        if not init_done.wait(init_timeout):
+            sys.stderr.write(
+                f"child[{platform}]: backend init exceeded "
+                f"{init_timeout:.0f}s (tunnel down?) — failing fast\n")
+            sys.stderr.flush()
+            os._exit(3)
+
+    threading.Thread(target=_init_watchdog, daemon=True).start()
     import jax
 
     if platform == "cpu":
@@ -75,6 +94,7 @@ def _measure_child():
     jax.config.update("jax_compilation_cache_dir", "/tmp/trino_tpu_jax_cache")
     sys.stderr.write(f"child[{platform}]: jax ready {time.time() - t0:.1f}s\n")
     devs = jax.devices()
+    init_done.set()
     sys.stderr.write(f"child[{platform}]: devices {devs} "
                      f"{time.time() - t0:.1f}s\n")
 
@@ -197,7 +217,7 @@ def _base_for(cache, res):
     return base
 
 
-def _emit(state, res, suffix, base):
+def _emit(state, res, suffix, base, cached_base=False):
     q = res.get("query", "q1")
     if res.get("stages"):
         # per-stage wall-time breakdown + jit-trace counts ride along as
@@ -205,22 +225,38 @@ def _emit(state, res, suffix, base):
         # the headline stays last on stdout)
         bd = res["stages"]
         total = round(sum(bd["stage_ms"].values()), 1)
+        extra = {}
+        if bd.get("exchange_stats"):
+            extra["exchange_stats"] = bd["exchange_stats"]
         print(json.dumps({
             "metric": f"tpch_{q}_{res['schema']}_stage_wall_ms{suffix}",
             "value": total, "unit": "ms", "vs_baseline": 0.0,
             "stages": bd["stage_ms"], "compiles": bd["compiles"],
-            "jit_traces": res.get("jit_traces"),
+            "jit_traces": res.get("jit_traces"), **extra,
         }), flush=True)
+    ratio = round(res["rate"] / base, 3) if base else 0.0
     line = json.dumps({
         "metric": f"tpch_{q}_{res['schema']}_rows_per_sec{suffix}",
         "value": round(res["rate"], 1),
         "unit": "rows/s",
-        "vs_baseline": round(res["rate"] / base, 3) if base else 0.0,
+        "vs_baseline": ratio,
     })
     state["line"] = line
     if q == "q3":
         state["q3_line"] = line
     print(line, flush=True)
+    # the ratchet: a CPU rate below its COMMITTED cached baseline is a
+    # failing check (round 5's q1 slid to 0.928 with nothing tripping) —
+    # an explicit *_regressed line plus a nonzero exit from main().
+    # Same-run solo baselines are exempt (ratio there is ~1 by
+    # construction); threshold overridable for noisy hosts.
+    floor = float(os.environ.get("BENCH_RATCHET_MIN", "1.0"))
+    if cached_base and suffix == "_cpu_fallback" and base and ratio < floor:
+        state.setdefault("regressed", []).append(json.dumps({
+            "metric": f"tpch_{q}_{res['schema']}_rows_per_sec_regressed",
+            "value": ratio, "unit": "x_vs_baseline",
+            "vs_baseline": ratio,
+        }))
 
 
 def main():
@@ -264,7 +300,9 @@ def main():
     sys.stderr.write(f"bench: cpu child tail:\n{cpu_text[-800:]}\n")
     solo_base = {}
     for res in cpu_results:
-        _emit(state, res, "_cpu_fallback", _base_for(cache, res))
+        cbase = _base_for(cache, res)
+        _emit(state, res, "_cpu_fallback", cbase,
+              cached_base=cbase is not None)
         # uncached query:schema: the phase-1 rate was measured solo, so
         # it is a sound (if unpersisted) baseline for the ratio
         solo_base[res.get("query", "q1")] = res["rate"]
@@ -294,7 +332,8 @@ def main():
 
     for res in tpu_results:
         q = res.get("query", "q1")
-        base = _base_for(cache, res) or solo_base.get(q)
+        cbase = _base_for(cache, res)
+        base = cbase or solo_base.get(q)
         is_tpu = "cpu" not in res["device"].lower()
         # a CPU-fallback run must not masquerade as a per-chip TPU
         # number; and if the default platform resolved to CPU, don't
@@ -302,7 +341,8 @@ def main():
         if is_tpu:
             _emit(state, res, "_per_chip", base)
         elif q not in solo_base:
-            _emit(state, res, "_cpu_fallback", base)
+            _emit(state, res, "_cpu_fallback", base,
+                  cached_base=cbase is not None)
     # any query with no emitted line at all gets an explicit failed
     # line, so a child killed between its q1 and q3 prints cannot leave
     # the q1 line masquerading as the headline (last-line) metric
@@ -319,11 +359,20 @@ def main():
             if state["line"] is None:
                 state["line"] = line
             print(line, flush=True)
-    # a late q1 failed line must not displace a real q3 headline as the
-    # LAST stdout line — re-assert it
-    if printed_failed and state.get("q3_line"):
+    # ratchet verdict: regressed lines print before the headline gets
+    # re-asserted, then main exits nonzero so the check FAILS loudly
+    regressed = state.get("regressed", [])
+    for line in regressed:
+        print(line, flush=True)
+    # a late q1 failed / regressed line must not displace a real q3
+    # headline as the LAST stdout line — re-assert it
+    if (printed_failed or regressed) and state.get("q3_line"):
         state["line"] = state["q3_line"]
         print(state["q3_line"], flush=True)
+    if regressed:
+        sys.stderr.write(f"bench: {len(regressed)} metric(s) regressed "
+                         "below the cached baseline\n")
+        sys.exit(1)
 
 
 if __name__ == "__main__":

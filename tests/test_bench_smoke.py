@@ -66,6 +66,69 @@ def test_q18_hash_and_sort_paths_identical():
     assert agg_groups["hash"] == agg_groups["sort"] > 1000
 
 
+def _load_bench():
+    """Import bench.py by path (it is an entry script, not a package
+    module; importing it runs no measurement)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "_bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_ratchet_flags_regression(capsys):
+    """A CPU rate below its COMMITTED cached baseline must produce an
+    explicit *_regressed line in state (round 5's q1 0.928 sailed
+    through silently); same-run solo baselines are exempt."""
+    bench = _load_bench()
+    res = {"query": "q1", "schema": "tiny", "rate": 900.0}
+    state = {}
+    bench._emit(state, res, "_cpu_fallback", 1000.0, cached_base=True)
+    out = capsys.readouterr().out
+    assert '"vs_baseline": 0.9' in out
+    regressed = state.get("regressed", [])
+    assert len(regressed) == 1
+    line = json.loads(regressed[0])
+    assert line["metric"] == "tpch_q1_tiny_rows_per_sec_regressed"
+    assert line["value"] == 0.9
+
+    # at/above baseline: no regression flag
+    state2 = {}
+    bench._emit(state2, res, "_cpu_fallback", 900.0, cached_base=True)
+    assert not state2.get("regressed")
+    # same-run solo baseline: exempt however low the ratio
+    state3 = {}
+    bench._emit(state3, res, "_cpu_fallback", 10_000.0, cached_base=False)
+    assert not state3.get("regressed")
+    # per-chip TPU lines have no TPU baseline to ratchet against
+    state4 = {}
+    bench._emit(state4, res, "_per_chip", 10_000.0, cached_base=True)
+    assert not state4.get("regressed")
+
+
+def test_bench_child_init_watchdog_fails_fast():
+    """A measurement child whose backend init never completes must exit
+    within seconds (distinct rc=3), not hang its whole 380 s budget —
+    the round-5 failure mode (VERDICT directive 1a). A hanging axon
+    tunnel cannot be faked portably, so the hang is simulated with a
+    watchdog timeout shorter than any possible `import jax`."""
+    import time
+
+    env = dict(os.environ, BENCH_ROLE="measure", BENCH_PLATFORM="default",
+               BENCH_SCHEMA="micro", BENCH_INIT_TIMEOUT="0.2",
+               JAX_PLATFORMS="cpu")
+    t0 = time.time()
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=90)
+    took = time.time() - t0
+    assert proc.returncode == 3, (proc.returncode, proc.stderr[-500:])
+    assert "failing fast" in proc.stderr
+    assert took < 60
+
+
 @pytest.mark.slow
 def test_bench_measure_child_micro_cpu():
     env = dict(os.environ, BENCH_ROLE="measure", BENCH_PLATFORM="cpu",

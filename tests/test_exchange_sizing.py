@@ -1,0 +1,195 @@
+"""Skew-adaptive device-exchange sizing (parallel/device_exchange.py).
+
+The overflow protocol used to be a 2x cost cliff: lane overflow re-ran
+the WHOLE all_to_all with doubled per_dest, so a skewed key distribution
+paid the full shuffle twice or more. These tests pin the count-first
+protocol: a 90%-of-rows-in-one-partition exchange completes with ZERO
+doubling retries and exactly one data collective (exact mode), the
+per-shape history pre-sizes repeat shapes without re-counting OR
+recompiling (asserted via jit_stats), legacy mode still shows the cliff
+(the knob works), and the skew stats surface identically on the device
+and host paths through EXPLAIN ANALYZE.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from trino_tpu import jit_stats
+from trino_tpu import types as T
+from trino_tpu.block import DevicePage, Page
+from trino_tpu.parallel.device_exchange import (DeviceExchange,
+                                                SIZING_HISTORY)
+
+SIZING_KERNELS = ("device_exchange_program", "device_exchange_count")
+
+
+@pytest.fixture(autouse=True)
+def fresh_history():
+    SIZING_HISTORY.reset()
+    yield
+    SIZING_HISTORY.reset()
+
+
+def _skewed_exchange(sizing: str, n: int = 4, d: int = None,
+                     rows_per_task: int = 1000, hot_frac: float = 0.9,
+                     seed: int = 0) -> DeviceExchange:
+    """Build + drain a DeviceExchange where ~hot_frac of all rows carry
+    ONE key (=> one hot partition). Returns the collected exchange."""
+    devs = jax.devices()
+    d = n if d is None else d
+    ex = DeviceExchange(n, devs[:d], sizing=sizing)
+    ex.configure([T.BIGINT, T.BIGINT], [0])
+    rng = np.random.default_rng(seed)
+    for t in range(n):
+        keys = np.where(rng.random(rows_per_task) < hot_frac, 7,
+                        rng.integers(0, 10_000, rows_per_task))
+        vals = rng.integers(0, 100, rows_per_task)
+        p = Page.from_pylists([T.BIGINT, T.BIGINT],
+                              [keys.tolist(), vals.tolist()])
+        ex.add_page(t, DevicePage.from_page(p))
+    ex.set_no_more_pages()
+    # drain every partition (first pages() call triggers the collective)
+    total = sum(pg.count() for part in range(n) for pg in ex.pages(part))
+    assert total == n * rows_per_task
+    return ex
+
+
+def test_exact_sizing_zero_retries_single_data_collective():
+    before = DeviceExchange.total_collectives
+    ex = _skewed_exchange("exact")
+    assert ex.collective_ran
+    assert ex.a2a_retries == 0
+    assert ex.data_collectives == 1
+    assert ex.count_collectives == 1
+    assert DeviceExchange.total_collectives - before == 1
+    s = ex.stats
+    assert s["sizing_used"] == "exact"
+    # 90% of 4000 rows in one of 4 partitions: skew ratio near 4 * 0.9
+    assert s["skew_ratio"] > 2.5
+    assert max(s["partition_rows"]) > 0.85 * s["rows"]
+    assert s["per_dest"] >= s["observed_max_pair_rows"]
+    assert s["bytes_moved"] > 0
+
+
+def test_history_presizes_repeat_without_count_or_recompile():
+    ex1 = _skewed_exchange("history", seed=1)
+    assert ex1.count_collectives == 1  # unconfident: counted
+    assert ex1.a2a_retries == 0
+    traces_before = jit_stats.total_for(*SIZING_KERNELS)
+    ex2 = _skewed_exchange("history", seed=1)
+    # presized from history: no count pass, no doubling, and the data
+    # program came straight from the lru_cache (zero new traces)
+    assert ex2.count_collectives == 0
+    assert ex2.a2a_retries == 0
+    assert ex2.data_collectives == 1
+    assert ex2.stats["sizing_used"] == "history"
+    assert ex2.stats["per_dest"] == ex1.stats["per_dest"]
+    assert jit_stats.total_for(*SIZING_KERNELS) == traces_before, (
+        "history-presized repeat shape recompiled an exchange kernel")
+
+
+def test_legacy_mode_pays_the_doubling_cliff():
+    ex = _skewed_exchange("legacy")
+    assert ex.count_collectives == 0
+    assert ex.a2a_retries >= 1  # the 2x cliff the count pass removes
+    assert ex.data_collectives == ex.a2a_retries + 1
+    assert ex.stats["sizing"] == "legacy"
+
+
+def test_stale_history_recovers_via_backstop_and_relearns():
+    """An undersized history presize must not wedge the exchange: the
+    doubling backstop completes it, and the observation re-teaches the
+    history so the NEXT run presizes correctly."""
+    # teach the history a tiny load for this exchange shape
+    ex_small = _skewed_exchange("history", rows_per_task=40,
+                                hot_frac=0.0, seed=2)
+    assert ex_small.a2a_retries == 0
+    # same shape signature (types/keys/n/d), much bigger skewed load
+    ex_big = _skewed_exchange("history", rows_per_task=4000, seed=3)
+    assert ex_big.count_collectives == 0  # presized (stale)
+    assert ex_big.a2a_retries >= 1        # backstop fired
+    ex_next = _skewed_exchange("history", rows_per_task=4000, seed=3)
+    assert ex_next.a2a_retries == 0       # history re-learned
+
+
+@pytest.mark.parametrize("d", [1, 2])
+def test_skew_with_fewer_devices_than_partitions(d):
+    """The d<p carried-partition path under 90% skew: partitions split
+    device slabs by carried id, sizing stays exact (zero retries), and
+    every row lands in its hash partition."""
+    import jax.numpy as jnp
+
+    from trino_tpu.parallel.exchange import hash_partition_ids
+
+    n = 4
+    ex = _skewed_exchange("exact", n=n, d=d, rows_per_task=500)
+    assert ex.d == d and ex.n == n
+    assert ex.a2a_retries == 0
+    assert ex.data_collectives == 1
+    assert sum(ex.stats["partition_rows"]) == ex.stats["rows"]
+    # routing correctness: rows of partition p hash to p
+    for part in range(n):
+        for pg in ex.pages(part):
+            keys = np.asarray(pg.cols[0])[np.asarray(pg.valid)]
+            if len(keys) == 0:
+                continue
+            got = np.asarray(hash_partition_ids(
+                [jnp.asarray(keys).astype(jnp.int64).view(jnp.uint64)],
+                n))
+            assert (got == part).all()
+
+
+def test_host_buffer_stats_parity():
+    """The host path exposes the SAME stats surface (keys) the device
+    path records, so EXPLAIN ANALYZE renders both identically."""
+    from trino_tpu.ops.output import OutputBuffer
+
+    buf = OutputBuffer(4)
+    for p, rows in ((0, 90), (1, 5), (2, 5)):
+        page = Page.from_pylists([T.BIGINT], [list(range(rows))])
+        buf.enqueue(p, page)
+    s = buf.stats
+    assert s["kind"] == "host"
+    assert s["rows"] == 100
+    assert s["partition_rows"] == [90, 5, 5, 0]
+    assert s["skew_ratio"] == 3.6
+    ex = _skewed_exchange("exact", seed=4)
+    assert set(s) <= set(ex.stats) | {"source_fragment"}
+
+
+def test_explain_analyze_shows_exchange_skew_lines():
+    """Acceptance surface: EXPLAIN ANALYZE shows per-exchange skew
+    ratio, per_dest chosen, and retry count on the device path."""
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.parallel.distributed import DistributedQueryRunner
+    from trino_tpu.sql.analyzer import Session
+
+    s = Session(catalog="tpch", schema="micro")
+    s.properties["device_exchange"] = True
+    s.properties["device_exchange_sizing"] = "exact"
+    r = DistributedQueryRunner({"tpch": TpchConnector(page_rows=2048)}, s,
+                               n_workers=3, desired_splits=8)
+    res = r.execute(
+        "EXPLAIN ANALYZE SELECT l_returnflag, count(*), sum(l_quantity) "
+        "FROM lineitem GROUP BY l_returnflag")
+    text = "\n".join(row[0] for row in res.rows)
+    device_lines = [ln for ln in text.splitlines()
+                    if "exchange [device]" in ln]
+    assert device_lines, text
+    for ln in device_lines:
+        assert "skew" in ln and "per_dest=" in ln and "retries=" in ln
+        assert "sizing=exact" in ln
+    # host-side boundaries of the same query render the same shape
+    assert any("exchange [host]" in ln for ln in text.splitlines())
+
+
+def test_sizing_session_property_validates_and_normalizes():
+    from trino_tpu.session_properties import set_property
+    from trino_tpu.types import TrinoError
+
+    props = {}
+    set_property(props, "device_exchange_sizing", "EXACT")
+    assert props["device_exchange_sizing"] == "exact"
+    with pytest.raises(TrinoError):
+        set_property(props, "device_exchange_sizing", "sometimes")

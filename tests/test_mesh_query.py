@@ -21,9 +21,50 @@ def test_mesh_q1_matches_local(n):
 
 def test_mesh_q1_overflow_retry():
     """per_dest=1 forces exchange overflow; the protocol doubles capacity
-    and re-runs instead of aborting."""
+    and re-runs instead of aborting. With the split program the retry
+    re-runs ONLY the exchange+final, never the scan/partial-agg."""
     devices = jax.devices("cpu")[:4]
+    from trino_tpu import jit_stats
+
+    s1_before = jit_stats.total_for("mesh_q1_stage1")
     rows, retries, _conn, _pages = run_q1_mesh(devices, schema="micro",
                                                per_dest=1)
     assert retries >= 1
     assert len(rows) == 4  # q1 has 4 (returnflag, linestatus) groups
+    # stage 1 traced at most once; the doubling only re-built the
+    # exchange+final program (the old fused protocol re-paid stage 1
+    # per retry — the 2x cliff)
+    assert jit_stats.total_for("mesh_q1_stage1") - s1_before <= 1
+
+
+def test_mesh_q1_repeat_run_does_not_retrace():
+    """Repeat runs reuse the memoized stage1/exchange+final programs
+    (and their jit caches) — a fresh build per call would re-trace and
+    re-lower both SPMD programs every invocation."""
+    from trino_tpu import jit_stats
+
+    devices = jax.devices("cpu")[:4]
+    run_q1_mesh(devices, schema="micro")  # warm
+    before = jit_stats.total_for("mesh_q1_stage1",
+                                 "mesh_q1_exchange_final")
+    run_q1_mesh(devices, schema="micro")
+    assert jit_stats.total_for("mesh_q1_stage1",
+                               "mesh_q1_exchange_final") == before
+
+
+def test_mesh_q1_count_first_sizing_zero_retries():
+    """Default (count-first) sizing: stage 1's histogram collective
+    picks per_dest exactly, so the data all_to_all runs ONCE with zero
+    doubling retries, and the skew stats come back filled."""
+    devices = jax.devices("cpu")[:4]
+    stats = {}
+    rows, retries, _conn, _pages = run_q1_mesh(devices, schema="micro",
+                                               stats_out=stats)
+    assert retries == 0
+    assert len(rows) == 4
+    assert stats["sizing"] == "exact"
+    assert stats["data_collectives"] == 1
+    assert stats["per_dest"] >= stats["observed_max_pair_rows"]
+    assert len(stats["partition_rows"]) == 4
+    assert sum(stats["partition_rows"]) == stats["rows"] > 0
+    assert stats["skew_ratio"] >= 1.0

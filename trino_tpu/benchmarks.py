@@ -195,11 +195,15 @@ _STAGE_BUCKETS = (
 def stage_breakdown(drivers: Sequence[Driver]) -> dict:
     """Per-stage wall-time/compile rollup of collect_stats drivers:
     {"stage_ms": {scan|filter_project|agg|join|exchange|sort|other: ms},
-     "compiles": total jit traces attributed to the drivers}."""
+     "compiles": total jit traces attributed to the drivers,
+     "exchange_stats": skew stats of any exchange boundary the drivers
+     touched (per_dest / retries / skew_ratio / partition_rows)}."""
     ms = {name: 0.0 for name, _ in _STAGE_BUCKETS}
     ms["other"] = 0.0
     compiles = 0
+    exchange_stats = []
     for d in drivers:
+        d.collect_operator_metrics()
         for st in d.stats:
             bucket = "other"
             for name, prefixes in _STAGE_BUCKETS:
@@ -208,8 +212,11 @@ def stage_breakdown(drivers: Sequence[Driver]) -> dict:
                     break
             ms[bucket] += st.wall_ns / 1e6
             compiles += st.compile_count
+            if st.metrics:
+                exchange_stats.append({"operator": st.name, **st.metrics})
     return {"stage_ms": {k: round(v, 1) for k, v in ms.items()},
-            "compiles": compiles}
+            "compiles": compiles,
+            "exchange_stats": exchange_stats}
 
 
 def build_q3_drivers(cust_pages: Sequence[Page],

@@ -31,12 +31,25 @@ class OperatorStats:
     output_pages: int = 0
     wall_ns: int = 0
     compile_count: int = 0
+    #: operator-reported metrics (exchange skew stats etc.), pulled from
+    #: ``op.metrics()`` once the driver finishes — the OperatorStats
+    #: analog of the reference's per-operator Metrics map
+    metrics: Optional[dict] = None
 
     def line(self) -> str:
         ms = self.wall_ns / 1e6
-        return (f"{self.name}: {self.output_rows} rows, "
+        base = (f"{self.name}: {self.output_rows} rows, "
                 f"{self.output_pages} pages, {ms:.1f}ms, "
                 f"{self.compile_count} compiles")
+        if self.metrics:
+            m = self.metrics
+            extras = " ".join(
+                f"{k}={m[k]}" for k in ("skew_ratio", "per_dest",
+                                        "a2a_retries", "sizing")
+                if m.get(k) is not None)
+            if extras:
+                base += f" [exchange {extras}]"
+        return base
 
 
 class Driver:
@@ -110,6 +123,17 @@ class Driver:
                 ops[0].finish()
         self.last_moved = moved
         return ops[-1].is_finished()
+
+    def collect_operator_metrics(self):
+        """Pull per-operator metrics (exchange skew stats etc.) into the
+        stats entries. Call after the driver finished: exchange sources
+        only know their stats once the upstream collective ran."""
+        for op, st in zip(self.operators, self.stats):
+            m = getattr(op, "metrics", None)
+            if callable(m):
+                got = m()
+                if got:
+                    st.metrics = dict(got)
 
     def blocked_tokens(self) -> List:
         """Listen tokens of currently-blocked operators. Meaningful

@@ -525,7 +525,12 @@ class LocalExecutionPlanner:
         thunk = self.exchange_reader(node.fragment_id, node.kind)
         from ..ops.output import ExchangeSourceOperator
 
-        source = ExchangeSourceOperator(thunk, types_)
+        # source_fragment tags the operator's exchange metrics (skew
+        # ratio, per_dest, retries) with the PRODUCING fragment, so
+        # EXPLAIN ANALYZE attributes a boundary's stats unambiguously
+        # when a stage consumes several remote sources (joins)
+        source = ExchangeSourceOperator(thunk, types_,
+                                        source_fragment=node.fragment_id)
         return [source], layout, types_
 
     def _v_IntersectNode(self, node: IntersectNode):

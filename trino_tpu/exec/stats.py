@@ -39,7 +39,8 @@ class TaskStatsTree:
                 {"name": o.name, "rows": o.output_rows,
                  "pages": o.output_pages,
                  "wall_ms": round(o.wall_ns / 1e6, 2),
-                 "compiles": o.compile_count}
+                 "compiles": o.compile_count,
+                 **({"exchange": o.metrics} if o.metrics else {})}
                 for o in self.operators],
         }
 
@@ -50,14 +51,41 @@ class StageStatsTree:
     partitioning: str
     output_kind: str
     tasks: List[TaskStatsTree] = field(default_factory=list)
+    #: output-boundary exchange skew stats (device collective or host
+    #: buffer — the same dict surface either way), attached by the
+    #: runner once the query completes
+    exchange: Optional[Dict] = None
 
     def to_dict(self) -> dict:
         return {
             "stage_id": self.stage_id,
             "partitioning": self.partitioning,
             "output_kind": self.output_kind,
+            "exchange": self.exchange,
             "tasks": [t.to_dict() for t in self.tasks],
         }
+
+    def exchange_line(self) -> Optional[str]:
+        """One EXPLAIN ANALYZE line for this stage's output exchange:
+        identical shape for the device-collective and host paths."""
+        ex = self.exchange
+        if not ex:
+            return None
+        parts = [f"exchange [{ex.get('kind', '?')}]:",
+                 f"{ex.get('rows', 0)} rows,",
+                 f"skew {ex.get('skew_ratio', 0.0):.2f}"]
+        if ex.get("sizing") is not None:
+            parts.append(f", sizing={ex['sizing']}")
+        if ex.get("per_dest") is not None:
+            parts.append(f", per_dest={ex['per_dest']}")
+        parts.append(f", retries={ex.get('a2a_retries', 0)}")
+        if ex.get("data_collectives"):
+            parts.append(
+                f", collectives={ex.get('count_collectives', 0)}"
+                f"+{ex['data_collectives']}")
+        if ex.get("bytes_moved") is not None:
+            parts.append(f", {ex['bytes_moved']} bytes moved")
+        return " ".join(p.strip() for p in parts).replace(" ,", ",")
 
 
 @dataclass
@@ -90,6 +118,9 @@ class QueryStatsTree:
                 f"Stage {s.stage_id} [{s.partitioning} -> "
                 f"{s.output_kind}] {len(s.tasks)} tasks, "
                 f"{total_rows} rows out")
+            ex_line = s.exchange_line()
+            if ex_line:
+                lines.append("    " + ex_line)
             # aggregate the per-operator view across tasks (positional:
             # every task of a stage runs the same operator chain)
             agg: Dict[int, OperatorStats] = {}
@@ -99,12 +130,18 @@ class QueryStatsTree:
                     if a is None:
                         agg[i] = OperatorStats(o.name, o.output_rows,
                                                o.output_pages, o.wall_ns,
-                                               o.compile_count)
+                                               o.compile_count,
+                                               metrics=o.metrics)
                     else:
                         a.output_rows += o.output_rows
                         a.output_pages += o.output_pages
                         a.wall_ns += o.wall_ns
                         a.compile_count += o.compile_count
+                        # exchange metrics describe the ONE shared
+                        # boundary object; every task reports the same
+                        # dict, so keep the first
+                        if a.metrics is None:
+                            a.metrics = o.metrics
             for i in sorted(agg):
                 lines.append("    " + agg[i].line())
             for t in s.tasks:

@@ -42,6 +42,14 @@ def total() -> int:
         return sum(_counts.values())
 
 
+def total_for(*names: str) -> int:
+    """Sum of the named counters (0 for never-traced kernels) — lets
+    tests assert "this specific kernel did not retrace" without being
+    perturbed by unrelated kernels tracing concurrently."""
+    with _lock:
+        return sum(_counts.get(n, 0) for n in names)
+
+
 def thread_total() -> int:
     """Traces recorded on THIS thread. Tracing runs synchronously on
     the thread that called the jitted function, so snapshot deltas of

@@ -84,6 +84,11 @@ class OutputBuffer:
         self._version = 0
         self._listeners: List[tuple] = []  # (cb, seen_version)
         self._total_rows = 0
+        #: per-PARTITION enqueued row counts (broadcast: one logical
+        #: partition) — the host-path skew observability mirroring
+        #: DeviceExchange.stats, so EXPLAIN ANALYZE reads identically
+        #: whichever path a stage boundary took
+        self._partition_rows = [0] * (1 if broadcast else num_partitions)
         # streaming observability: did any consumer dequeue a page
         # before the producers finished?
         self.first_poll_ts: Optional[float] = None
@@ -118,6 +123,8 @@ class OutputBuffer:
                 return
             self._pages[0 if self.broadcast else partition].append(page)
             self._total_rows += page.num_rows
+            self._partition_rows[0 if self.broadcast
+                                 else partition] += page.num_rows
             fired = self._bump_locked()
         for cb in fired:
             cb()
@@ -227,6 +234,28 @@ class OutputBuffer:
     def total_rows(self) -> int:
         with self._lock:
             return self._total_rows
+
+    @property
+    def stats(self) -> dict:
+        """Host-path exchange skew stats — the SAME surface as
+        ``DeviceExchange.stats`` (partition_rows / skew_ratio / rows),
+        with device-only fields pinned to host values, so EXPLAIN
+        ANALYZE renders stage boundaries identically on both paths."""
+        with self._lock:
+            rows = list(self._partition_rows)
+        mean_rows = (sum(rows) / len(rows)) if rows else 0.0
+        return {
+            "kind": "host",
+            "sizing": None,
+            "per_dest": None,
+            "a2a_retries": 0,
+            "count_collectives": 0,
+            "data_collectives": 0,
+            "rows": sum(rows),
+            "partition_rows": rows,
+            "skew_ratio": (round(max(rows) / mean_rows, 3)
+                           if mean_rows > 0 else 0.0),
+        }
 
     @property
     def overlapped(self) -> bool:
@@ -341,6 +370,13 @@ class PartitionedOutputOperator(Operator):
                 blocks.append(Block(t, c[idx], bn if bn.any() else None, d))
             self.buffer.enqueue(p, Page(blocks, len(idx)))
 
+    def metrics(self) -> Optional[dict]:
+        """Host-path exchange stats for OperatorStats (hash kind only:
+        single/broadcast/merge routing has no skew to observe)."""
+        if self.kind != "hash":
+            return None
+        return self.buffer.stats
+
     def get_output(self):
         if self._finishing:
             self._done = True
@@ -366,11 +402,15 @@ class ExchangeSourceOperator(SourceOperator):
       when no page is available the operator reports a blocked token so
       the task executor parks the task instead of spinning."""
 
-    def __init__(self, pages_thunk, types_: Sequence[T.Type]):
+    def __init__(self, pages_thunk, types_: Sequence[T.Type],
+                 source_fragment: Optional[int] = None):
         self._streaming = hasattr(pages_thunk, "poll")
         self._chan = pages_thunk if self._streaming else None
         self._thunk = None if self._streaming else pages_thunk
         self.types = list(types_)
+        #: producing fragment id (EXPLAIN ANALYZE attribution of the
+        #: exchange metrics below)
+        self.source_fragment = source_fragment
         self._pages: Optional[List[Page]] = None
         self._done = False
         #: streaming: the stable target pool per pooled channel — the
@@ -380,6 +420,22 @@ class ExchangeSourceOperator(SourceOperator):
 
     def add_split(self, split):
         raise AssertionError("exchange source has no splits")
+
+    def metrics(self) -> Optional[dict]:
+        """The upstream exchange's skew stats, read from the consumer
+        side — by the time this driver finishes, the collective has run
+        (device path) / all producers enqueued (host path)."""
+        chan = self._chan
+        stats = None
+        if chan is not None:
+            stats = getattr(chan, "stats", None)
+            if stats is None:
+                buf = getattr(chan, "buffer", None)
+                stats = getattr(buf, "stats", None)
+        if stats and self.source_fragment is not None:
+            stats = dict(stats)
+            stats["source_fragment"] = self.source_fragment
+        return stats
 
     def blocked_token(self):
         if self._streaming and not self._done:

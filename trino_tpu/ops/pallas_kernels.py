@@ -103,8 +103,8 @@ def _kernel(starts_ref, col_ref, gid_ref, out_ref, *, kind: str,
         out_ref[:] = jnp.full(out_ref.shape, ident, out_ref.dtype)
 
     start = starts_ref[i]
-    col = col_ref[0, :]                      # (C,)
-    local = gid_ref[0, :] - start            # (C,) window offsets
+    col = col_ref[0, 0, :]                   # (C,)
+    local = gid_ref[0, 0, :] - start         # (C,) window offsets
     in_win = (local >= 0) & (local < _WIN)
     # one-hot binning matrix: onehot[r, w] == row r feeds window slot w
     wslots = jax.lax.broadcasted_iota(jnp.int32, (_CHUNK, _WIN), 1)
@@ -149,8 +149,14 @@ def _kernel(starts_ref, col_ref, gid_ref, out_ref, *, kind: str,
     else:
         contrib = jnp.where(onehot, col[:, None],
                             jnp.asarray(ident, col.dtype))
-        win = (jnp.min(contrib, axis=0) if kind == "min"
-               else jnp.max(contrib, axis=0))
+        # pairwise halving tree instead of reduce_min/max: Mosaic has no
+        # integer reduction lowering, but elementwise minimum/maximum
+        # lowers for every dtype; _CHUNK is a power of two
+        op = jnp.minimum if kind == "min" else jnp.maximum
+        while contrib.shape[0] > 1:
+            half = contrib.shape[0] // 2
+            contrib = op(contrib[:half], contrib[half:])
+        win = contrib[0]
         cur = out_ref[0, pl.dslice(start, _WIN)]
         upd = jnp.minimum(cur, win) if kind == "min" \
             else jnp.maximum(cur, win)
@@ -180,6 +186,12 @@ def _segment_reduce_pallas(col, gid, num_segments: int, kind: str,
     gid = gid.astype(jnp.int32)
     starts = jnp.clip((gid[::_CHUNK] // _LANE) * _LANE, 0, s_alloc - _WIN)
 
+    # chunks are blocked as (1, 1, C) windows of a (n_chunks, 1, C)
+    # array: Mosaic requires each of the last two BLOCK dims to be
+    # divisible by the (8, 128) tile or equal to the array dim — the
+    # former 2-D (1, C) block over a (n_chunks, C) array violated the
+    # sublane rule whenever n_chunks > 1 and only ever lowered in
+    # interpret mode (caught by the AOT lowering smoke test)
     out = pl.pallas_call(
         functools.partial(_kernel, kind=kind, dtype=dtype,
                           n_chunks=n_chunks),
@@ -187,14 +199,15 @@ def _segment_reduce_pallas(col, gid, num_segments: int, kind: str,
             num_scalar_prefetch=1,
             grid=(n_chunks,),
             in_specs=[
-                pl.BlockSpec((1, _CHUNK), lambda i, s: (i, 0)),
-                pl.BlockSpec((1, _CHUNK), lambda i, s: (i, 0)),
+                pl.BlockSpec((1, 1, _CHUNK), lambda i, s: (i, 0, 0)),
+                pl.BlockSpec((1, 1, _CHUNK), lambda i, s: (i, 0, 0)),
             ],
             out_specs=pl.BlockSpec((1, s_alloc), lambda i, s: (0, 0)),
         ),
         out_shape=jax.ShapeDtypeStruct((1, s_alloc), col.dtype),
         interpret=interpret,
-    )(starts, col.reshape(n_chunks, _CHUNK), gid.reshape(n_chunks, _CHUNK))
+    )(starts, col.reshape(n_chunks, 1, _CHUNK),
+      gid.reshape(n_chunks, 1, _CHUNK))
     return out[0, :num_segments]
 
 

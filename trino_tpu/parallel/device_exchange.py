@@ -17,10 +17,30 @@ key hashing uses a value-stable crc LUT so equal strings route equally
 regardless of pool. This is the exchange-boundary "pool unification"
 contract that downstream group-by/join kernels rely on.
 
-Overflow protocol: all_to_all lanes are fixed capacity (per_dest per
-sender/receiver pair); on overflow the host doubles per_dest and re-runs
-the collective — static shapes with a retry loop instead of the
-reference's unbounded buffers.
+Sizing protocol (skew-adaptive): all_to_all lanes are fixed capacity
+(per_dest per sender/receiver pair), so per_dest must be chosen before
+the data collective compiles. Three modes (``device_exchange_sizing``
+session property):
+
+- ``exact``: a count-first pass — a tiny counting collective (per-sender
+  destination histograms + psum/pmax, O(n*d) scalars, negligible vs the
+  payload) — observes the exact max (sender, dest) load and sizes
+  per_dest exactly; the doubling retry below becomes dead code in
+  practice (kept as a bug backstop).
+- ``history`` (default): a process-wide EWMA of observed max loads keyed
+  by exchange shape (types/keys/n/d — the plan-node signature),
+  pow2-bucketed through ``padded_size`` so repeat shapes reuse the
+  ``_exchange_program`` lru_cache; pre-sizes per_dest and skips the
+  count pass once confident, falling back to ``exact`` until then.
+- ``legacy``: the original guess (2*cap/d); on lane overflow the host
+  doubles per_dest and re-runs the whole collective — under real skew
+  that pays the full shuffle twice or more (the 2x cost cliff the
+  count-first pass removes).
+
+Every collective records skew observability into ``self.stats``:
+per-partition row counts, max/mean skew ratio, per_dest chosen, retries,
+collective count and bytes moved — surfaced through OperatorStats /
+EXPLAIN ANALYZE and the bench output.
 """
 
 from __future__ import annotations
@@ -35,14 +55,69 @@ import numpy as np
 
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import jit_stats
 from .. import types as T
 from ..block import DevicePage, Dictionary, padded_size
-from .exchange import (hash_partition_ids, key_to_u64, repartition_a2a,
-                       shard_map, string_hash_lut)
+from .exchange import (hash_partition_ids, key_to_u64, partition_histogram,
+                       repartition_a2a, shard_map, string_hash_lut)
 
 
 def device_exchange_supported(types_: Sequence[T.Type]) -> bool:
     return all(t.storage is not None for t in types_)
+
+
+SIZING_MODES = ("exact", "history", "legacy")
+
+
+class ExchangeSizingHistory:
+    """Process-wide EWMA of observed max (sender, dest) lane loads, keyed
+    by exchange shape (types/key_channels/n/d — the plan-node signature,
+    stable across queries of the same shape). ``presize`` returns a
+    pow2-bucketed per_dest through the SAME ``padded_size`` bucketing the
+    exact mode uses, so a stable workload re-lands on the identical
+    ``_exchange_program`` cache entry instead of recompiling.
+
+    Reference analog: the observed-size adaptive partition sizing of
+    ``HashDistributionSplitAssigner`` — capacity decided from counts seen,
+    not guessed (the hybrid-hash-join robustness argument)."""
+
+    def __init__(self, alpha: float = 0.5):
+        self.alpha = alpha
+        self._lock = threading.Lock()
+        self._ewma: Dict[tuple, float] = {}
+        self._obs: Dict[tuple, int] = {}
+
+    def observe(self, key: tuple, max_load: int) -> None:
+        with self._lock:
+            prev = self._ewma.get(key)
+            if prev is None or max_load >= prev:
+                # grow IMMEDIATELY: an undersized presize costs a full
+                # re-shuffle through the doubling backstop, an oversized
+                # one only pads lanes — so track load spikes at once and
+                # decay slowly
+                self._ewma[key] = float(max_load)
+            else:
+                self._ewma[key] = (self.alpha * max_load
+                                   + (1 - self.alpha) * prev)
+            self._obs[key] = self._obs.get(key, 0) + 1
+
+    def presize(self, key: tuple) -> Optional[int]:
+        """pow2-bucketed per_dest, or None while unconfident (no
+        observation yet for this exchange shape)."""
+        with self._lock:
+            if self._obs.get(key, 0) < 1:
+                return None
+            return padded_size(max(int(round(self._ewma[key])), 16))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ewma.clear()
+            self._obs.clear()
+
+
+#: the process-wide sizing history (one engine process = one history,
+#: like the jit caches it protects)
+SIZING_HISTORY = ExchangeSizingHistory()
 
 
 class DeviceExchange:
@@ -58,23 +133,37 @@ class DeviceExchange:
     ExchangeSourceOperator passes through).
     """
 
-    def __init__(self, n_partitions: int, devices: Sequence):
+    def __init__(self, n_partitions: int, devices: Sequence,
+                 sizing: str = "history",
+                 history_key: Optional[tuple] = None):
         # p-partitions-on-d-devices layout: with fewer devices than
         # partitions (a single real chip being the important case),
         # partition p lives on device p % d; partition ids are carried
         # through the collective and consumers split their device's slab
         # by mask. d == n degenerates to the exact 1:1 mapping.
         assert len(devices) >= 1
+        assert sizing in SIZING_MODES, sizing
         self.n = n_partitions
         self.devices = list(devices)[:min(n_partitions, len(devices))]
         self.d = len(self.devices)
+        self.sizing = sizing
+        #: history key override (defaults to the exchange shape —
+        #: types/key_channels/n/d — at collect time)
+        self.history_key = history_key
         self.types: Optional[List[T.Type]] = None
         self.key_channels: Optional[List[int]] = None
         self._by_task: Dict[int, List[DevicePage]] = {}
         self._lock = threading.Lock()
         self._result: Optional[List[List[DevicePage]]] = None
         self.a2a_retries = 0
+        self.count_collectives = 0
+        self.data_collectives = 0
         self.collective_ran = False  # test observability
+        #: skew observability of the last collective (per-partition row
+        #: counts, skew ratio, per_dest chosen, retries, bytes moved) —
+        #: populated by _collect, surfaced via OperatorStats / EXPLAIN
+        #: ANALYZE / bench
+        self.stats: Optional[Dict] = None
         # streaming-scheduler support: the collective is a barrier — it
         # needs every producer's rows — so consumers park on a listen
         # token until the runner signals set_no_more_pages()
@@ -108,6 +197,9 @@ class DeviceExchange:
     #: observability); guarded by _total_lock — instances have their own
     #: locks, and two exchanges can collect concurrently
     total_collectives = 0
+    #: process-wide count of count-first sizing collectives (history
+    #: hits skip them — assertable)
+    total_count_collectives = 0
     _total_lock = threading.Lock()
 
     # -- producer side --------------------------------------------------
@@ -217,26 +309,83 @@ class DeviceExchange:
                      for c in self.key_channels if types_[c].is_string)
 
         mesh = Mesh(np.asarray(self.devices), ("x",))
-        per_dest = padded_size(max(32, (2 * cap) // d))
+        tkey = tuple(types_)
+        kkey = tuple(self.key_channels)
+        hkey = self.history_key or (
+            tuple(str(t) for t in types_), kkey, n, d)
+        sizing = self.sizing
+        mode_used = sizing
+        per_dest = None
+        if sizing == "history":
+            per_dest = SIZING_HISTORY.presize(hkey)
+            if per_dest is None:
+                mode_used = "exact"  # unconfident: fall back to counting
+        if sizing == "exact" or (sizing == "history" and per_dest is None):
+            # count-first pass: the exact max (sender, dest) load from a
+            # tiny counting collective; per_dest needs no retry headroom
+            cprog = _count_program(mesh, tkey, kkey, n, d)
+            _hist, need = cprog(cols, nulls, valid, luts)
+            per_dest = padded_size(max(int(np.asarray(need)[0]), 16))
+            self.count_collectives += 1
+            with DeviceExchange._total_lock:
+                DeviceExchange.total_count_collectives += 1
+        elif sizing == "legacy":
+            per_dest = padded_size(max(32, (2 * cap) // d))
+        per_dest = min(per_dest, cap)
+        lanes_moved = 0
         while True:
-            prog = _exchange_program(mesh, tuple(types_),
-                                     tuple(self.key_channels), n, d,
-                                     per_dest)
+            prog = _exchange_program(mesh, tkey, kkey, n, d, per_dest)
             out_cols, out_nulls, out_valid, out_part, overflow = prog(
                 cols, nulls, valid, luts)
             jax.block_until_ready(out_valid)
+            self.data_collectives += 1
+            lanes_moved += d * d * per_dest  # at THIS attempt's capacity
             if int(np.asarray(overflow).sum()) == 0:
                 break
             if per_dest >= cap:
                 raise RuntimeError(
                     f"device exchange overflow with per_dest={per_dest} "
                     f">= sender capacity {cap} (bug, not skew)")
+            # backstop only: exact sizing cannot overflow; a stale
+            # history presize can, and the doubling recovers it (the
+            # observation below re-teaches the history)
             per_dest = min(per_dest * 2, cap)
             self.a2a_retries += 1
 
         self.collective_ran = True
         with DeviceExchange._total_lock:
             DeviceExchange.total_collectives += 1
+
+        # skew observability + history feedback, from the RESULT (costs
+        # one host transfer of the valid/partition lanes, no extra
+        # collective in any mode): receiver r's lanes [s*per_dest,
+        # (s+1)*per_dest) came from sender s, so per-(receiver, sender)
+        # valid counts give the exact max pair load actually observed
+        ov = np.asarray(out_valid)
+        op_ids = np.asarray(out_part)
+        pair_rows = ov.reshape(d, d, per_dest).sum(axis=2)
+        observed_max = int(pair_rows.max()) if pair_rows.size else 0
+        SIZING_HISTORY.observe(hkey, observed_max)
+        partition_rows = np.bincount(op_ids[ov], minlength=n)[:n]
+        mean_rows = float(partition_rows.mean()) if n else 0.0
+        lane_bytes = (sum(np.dtype(t.storage).itemsize for t in types_)
+                      + 4          # carried partition id (int32)
+                      + nch + 1)   # null masks + valid mask (bool lanes)
+        self.stats = {
+            "kind": "device",
+            "sizing": self.sizing,
+            "sizing_used": mode_used,
+            "per_dest": per_dest,
+            "observed_max_pair_rows": observed_max,
+            "a2a_retries": self.a2a_retries,
+            "count_collectives": self.count_collectives,
+            "data_collectives": self.data_collectives,
+            "rows": int(partition_rows.sum()),
+            "partition_rows": [int(r) for r in partition_rows],
+            "skew_ratio": (round(float(partition_rows.max()) / mean_rows, 3)
+                           if mean_rows > 0 else 0.0),
+            "bytes_moved": lanes_moved * lane_bytes,
+        }
         # release producer-side inputs: without this the exchange pins
         # ~2x the exchanged bytes in HBM for the rest of the query
         self._by_task.clear()
@@ -253,6 +402,58 @@ class DeviceExchange:
                             pv, out_dicts)
             result.append([dp])
         return result
+
+
+def _normalized_keys(cols, nulls, luts, types_: tuple,
+                     key_channels: tuple) -> List:
+    """Per-row uint64 key columns for partition hashing — THE one
+    normalization both the count and data programs run, so they cannot
+    disagree on routing (a disagreement would turn exact sizing into
+    silent overflow)."""
+    keys = []
+    li = 0
+    for c in key_channels:
+        lut = None
+        if types_[c].is_string:
+            lut = luts[li]
+            li += 1
+        keys.append(key_to_u64(cols[c], nulls[c], types_[c], lut))
+    return keys
+
+
+@lru_cache(maxsize=128)
+def _count_program(mesh: Mesh, types_: tuple, key_channels: tuple,
+                   n: int, d: int):
+    """The count-first pass: each sender histograms its live rows by
+    destination device, a psum gives the global per-partition row counts
+    and a pmax the exact max (sender, dest) lane load — O(n*d) scalars
+    over the mesh, negligible vs the payload it sizes (the DrJAX
+    observation: small pre-collectives are essentially free relative to
+    the data movement). Memoized on (mesh, types, keys, n, d); jit
+    re-traces per sender capacity only."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("x"), P("x"), P("x"), P(None)),
+             out_specs=(P("x"), P("x")),
+             check_vma=False)
+    def count(cols, nulls, valid, luts):
+        cols = tuple(c[0] for c in cols)
+        nulls = tuple(x[0] for x in nulls)
+        valid = valid[0]
+        keys = _normalized_keys(cols, nulls, luts, types_, key_channels)
+        part = hash_partition_ids(keys, n)
+        dest = part % d if d < n else part
+        part_hist = partition_histogram(part, valid, n)
+        pair_need = jnp.max(partition_histogram(dest, valid, d))
+        total_hist = jax.lax.psum(part_hist, "x")
+        max_need = jax.lax.pmax(pair_need, "x")
+        return total_hist[None], max_need[None]
+
+    def counted(cols, nulls, valid, luts):
+        jit_stats.bump("device_exchange_count")
+        return count(cols, nulls, valid, luts)
+
+    return jax.jit(counted)
 
 
 @lru_cache(maxsize=128)
@@ -275,14 +476,7 @@ def _exchange_program(mesh: Mesh, types_: tuple, key_channels: tuple,
         cols = tuple(c[0] for c in cols)
         nulls = tuple(x[0] for x in nulls)
         valid = valid[0]
-        keys = []
-        li = 0
-        for c in key_channels:
-            lut = None
-            if types_[c].is_string:
-                lut = luts[li]
-                li += 1
-            keys.append(key_to_u64(cols[c], nulls[c], types_[c], lut))
+        keys = _normalized_keys(cols, nulls, luts, types_, key_channels)
         part = hash_partition_ids(keys, n)
         dest = part % d if d < n else part
         false_ = jnp.zeros(valid.shape, dtype=bool)
@@ -293,7 +487,14 @@ def _exchange_program(mesh: Mesh, types_: tuple, key_channels: tuple,
                 tuple(x[None] for x in ex_nulls[:-1]),
                 ex_valid[None], ex_cols[-1][None], overflow[None])
 
-    return jax.jit(prog)
+    def exchanged(cols, nulls, valid, luts):
+        # trace-time counter OUTSIDE the shard_map body (which jax may
+        # re-trace for lowering): exactly one bump per XLA cache miss,
+        # so "repeat shapes do not recompile" is assertable
+        jit_stats.bump("device_exchange_program")
+        return prog(cols, nulls, valid, luts)
+
+    return jax.jit(exchanged)
 
 
 class _DeviceExchangeToken:
@@ -321,6 +522,12 @@ class DeviceExchangeChannel:
         self.ex = ex
         self.partition = partition
         self._pages: Optional[List[DevicePage]] = None
+
+    @property
+    def stats(self) -> Optional[Dict]:
+        """The exchange's skew stats (ready once the collective ran) —
+        the consumer-side surface ExchangeSourceOperator.metrics reads."""
+        return self.ex.stats
 
     def poll(self):
         if not self.ex._no_more:
@@ -363,6 +570,13 @@ class DeviceExchangeSinkOperator:
 
     def add_input(self, page: DevicePage):
         self.exchange.add_page(self.task_id, page)
+
+    def metrics(self) -> Optional[Dict]:
+        """Exchange skew stats for OperatorStats (None until a consumer
+        triggered the collective — producer tasks finish before it
+        runs; the stage-level attachment in distributed.py reads the
+        final value)."""
+        return self.exchange.stats
 
     def get_output(self):
         if self._finishing:

@@ -170,6 +170,15 @@ class DistributedQueryRunner:
                 fid: buf.overlapped for fid, buf in buffers.items()
                 if isinstance(buf, OutputBuffer)}
         if collect_stats:
+            # attach each stage's output-boundary exchange skew stats —
+            # only now, after every consumer ran: the device collective
+            # is consumer-triggered, so producer-stage completion would
+            # be too early to read it
+            by_stage = {s.stage_id: s for s in self._stage_stats}
+            for fid, buf in buffers.items():
+                stage = by_stage.get(fid)
+                if stage is not None:
+                    stage.exchange = getattr(buf, "stats", None)
             stats["query_stats"] = QueryStatsTree(
                 stages=self._stage_stats,
                 wall_ms=(_time.perf_counter() - t0) * 1e3,
@@ -369,6 +378,7 @@ class DistributedQueryRunner:
             else:
                 raise RuntimeError("driver did not finish")
             if collect:
+                d.collect_operator_metrics()
                 task.operators.extend(d.stats)
         if root is not None and results is not None:
             results[t] = plan.sink.pages
@@ -399,7 +409,9 @@ class DistributedQueryRunner:
         # through the collective, so a single real chip still executes
         # the flagship path
         devices = jax.devices()
-        return DeviceExchange(self.n_workers, devices)
+        return DeviceExchange(
+            self.n_workers, devices,
+            sizing=SP.value(self.session, "device_exchange_sizing"))
 
     def _run_fragment(self, executor, frag: PlanFragment, ntasks: int,
                       buffers: Dict[int, OutputBuffer]):

@@ -15,6 +15,14 @@ Capacity model: all_to_all needs equal-sized lanes, so each device sends a
 fixed ``per_dest`` lanes to each destination. Rows beyond capacity are
 counted in the returned ``overflow`` (host checks and can re-run with a
 larger factor); with hash partitioning overflow implies heavy skew.
+
+Count-first sizing: instead of guessing ``per_dest`` and paying the 2x
+re-run cliff on overflow, callers can first run a tiny counting
+collective (``partition_histogram`` + psum/pmax over the mesh — O(n*d)
+scalars, negligible vs the payload) to learn the exact max
+(sender, destination) load and size the data ``all_to_all`` exactly;
+the overflow retry then remains only as a bug backstop. See
+``parallel/device_exchange._count_program`` and ``mesh_query``.
 """
 
 from __future__ import annotations
@@ -27,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import jit_stats
 from .. import types as T
 
 try:  # jax >= 0.4.35 exports shard_map at top level
@@ -99,6 +108,17 @@ def hash_partition_ids(keys_u64: Sequence, num_partitions: int):
     return (acc % np.uint64(num_partitions)).astype(jnp.int32)
 
 
+def partition_histogram(part_ids, valid, num_partitions: int):
+    """Per-destination live-row counts of ONE sender (device op): the
+    count-first pass each sender runs before a collective to size its
+    lanes from data instead of a capacity guess. Dead rows drop into a
+    discarded overflow slot."""
+    idx = jnp.where(valid, part_ids, num_partitions).astype(jnp.int32)
+    hist = jnp.zeros((num_partitions + 1,), jnp.int32).at[idx].add(
+        1, mode="drop")
+    return hist[:num_partitions]
+
+
 @partial(jax.jit, static_argnames=("num_partitions", "per_dest", "axis_name"))
 def repartition_a2a(cols: Tuple, nulls: Tuple, valid, part_ids,
                     num_partitions: int, per_dest: int,
@@ -110,6 +130,7 @@ def repartition_a2a(cols: Tuple, nulls: Tuple, valid, part_ids,
     Implementation: bucket-sort rows by destination, lay them into a
     (num_partitions, per_dest) send grid, one lax.all_to_all, flatten.
     """
+    jit_stats.bump("repartition_a2a")
     cap = valid.shape[0]
     # sort rows by (invalid, destination): live rows grouped by dest
     dest = jnp.where(valid, part_ids, num_partitions)

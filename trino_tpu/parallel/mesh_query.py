@@ -13,27 +13,49 @@ scan shard -> fused filter/project -> local partial agg -> all_to_all
 repartition of groups -> merge-final aggregation on the owning device —
 and cross-checks the result against single-device execution.
 
-Overflow protocol: ``all_to_all`` lanes are fixed-capacity; on overflow
-(skew) the host doubles ``per_dest`` and re-runs — the analog of the
-reference's unbounded per-partition page buffers, made static-shape.
+Sizing protocol (count-first): the program is split at the exchange —
+stage 1 (fused filter/project + partial agg) also emits its
+per-destination live-group histogram plus a tiny ``psum``/``pmax`` of
+those counts, so the host knows the EXACT max (sender, dest) lane load
+before compiling the exchange+final program and ``per_dest`` needs no
+guessing. The legacy doubling retry remains as a backstop (and for
+callers pinning ``per_dest``), but a retry now re-runs only the
+exchange+final program, never stage 1 — the old fused-program protocol
+paid the whole scan+partial-agg again per doubling.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Sequence
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .. import jit_stats
 from ..block import Block, Page, padded_size
 from ..ops.aggregation import (_final_project, _group_reduce, _merge_states,
                                _state_plan)
 from ..ops.sortkeys import group_operands
-from .exchange import (hash_partition_ids, repartition_a2a,
-                       shard_map)
+from .exchange import (hash_partition_ids, partition_histogram,
+                       repartition_a2a, shard_map)
+
+
+#: memoized SPMD programs + expression builds: jax.jit caches live on
+#: the returned callables, so rebuilding one per run_q1_mesh call (or
+#: per retry) would re-trace + re-lower identical programs every time
+#: (the lru_cache analog of device_exchange._exchange_program; Mesh
+#: hashes by devices + axis names)
+_PROGRAM_CACHE: dict = {}
+
+
+def _cached_program(key, build):
+    hit = _PROGRAM_CACHE.get(key)
+    if hit is None:
+        hit = _PROGRAM_CACHE[key] = build()
+    return hit
 
 
 def _shard_page(page: Page, n_shards: int):
@@ -61,36 +83,73 @@ def _shard_page(page: Page, n_shards: int):
             jnp.asarray(valid))
 
 
-def q1_mesh_fn(mesh: Mesh, proc, step, aggs, per_dest: int):
-    """Build the jitted SPMD program: per-device partial agg -> all_to_all
-    exchange on group keys -> merge-final aggregation."""
+def q1_stage1_fn(mesh: Mesh, proc, step):
+    """Build the jitted stage-1 SPMD program: per-device fused
+    filter/project + local partial aggregation, PLUS the count-first
+    sizing collective — each device's per-destination live-group
+    histogram, psummed into global per-partition row counts and pmaxed
+    into the exact max (sender, dest) lane load. O(n^2) scalars over the
+    mesh, free next to the partial-agg compute it rides on."""
+    n = mesh.devices.size
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("x"), P("x"), P("x"), P(None)),
+             out_specs=(P("x"),) * 7,
+             check_vma=False)
+    def stage1(cols, nulls, valid, luts):
+        cols = tuple(c[0] for c in cols)
+        nulls = tuple(x[0] for x in nulls)
+        valid = valid[0]
+        kr, kn, states, pvalid = step(cols, nulls, valid, luts)
+        # route each partial group to its owning device. Keys are
+        # dictionary codes from pools shared across co-resident shards,
+        # so raw codes route consistently.
+        keys_u64 = [k.astype(jnp.int64).view(jnp.uint64) for k in kr]
+        part = hash_partition_ids(
+            [jnp.where(jnp.asarray(b), jnp.uint64(0), k)
+             for k, b in zip(keys_u64, kn)], n)
+        hist = partition_histogram(part, pvalid, n)
+        total_hist = jax.lax.psum(hist, "x")
+        max_need = jax.lax.pmax(jnp.max(hist), "x")
+        return (tuple(k[None] for k in kr),
+                tuple(jnp.asarray(b)[None] for b in kn),
+                tuple(s[None] for s in states),
+                pvalid[None], part[None],
+                total_hist[None], max_need[None])
+
+    def staged(cols, nulls, valid, luts):
+        jit_stats.bump("mesh_q1_stage1")
+        return stage1(cols, nulls, valid, luts)
+
+    return jax.jit(staged)
+
+
+def q1_exchange_final_fn(mesh: Mesh, proc, aggs, per_dest: int):
+    """Build the jitted exchange+final SPMD program: all_to_all of the
+    partial groups at the (count-first or caller-pinned) ``per_dest``,
+    then merge-final aggregation on the owning device. Separate from
+    stage 1 so a backstop retry re-runs ONLY the shuffle, never the
+    scan/partial-agg."""
     n = mesh.devices.size
     key_types = proc.output_types[:2]
     kinds = tuple(k for a in aggs for (k, _) in _state_plan(a))
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P("x"), P("x"), P("x"), P(None)),
+             in_specs=(P("x"), P("x"), P("x"), P("x"), P("x")),
              out_specs=(P("x"), P("x"), P("x"), P("x")),
              check_vma=False)
-    def dist(cols, nulls, valid, luts):
-        cols = tuple(c[0] for c in cols)
-        nulls = tuple(x[0] for x in nulls)
-        valid = valid[0]
-        # stage 1: fused filter/project + local partial aggregation
-        kr, kn, states, pvalid = step(cols, nulls, valid, luts)
-        # exchange: route each partial group to its owning device. Keys
-        # are dictionary codes from pools shared across co-resident
-        # shards, so raw codes route consistently.
-        keys_u64 = [k.astype(jnp.int64).view(jnp.uint64) for k in kr]
-        part = hash_partition_ids(
-            [jnp.where(jnp.asarray(b), jnp.uint64(0), k)
-             for k, b in zip(keys_u64, kn)], n)
+    def dist(kr, kn, states, pvalid, part):
+        kr = tuple(k[0] for k in kr)
+        kn = tuple(b[0] for b in kn)
+        states = tuple(s[0] for s in states)
+        pvalid = pvalid[0]
+        part = part[0]
         ex_cols, ex_nulls, ex_valid, overflow = repartition_a2a(
             tuple(kr) + tuple(states),
-            tuple(jnp.asarray(b) for b in kn) + tuple(
+            tuple(kn) + tuple(
                 jnp.zeros(s.shape, dtype=bool) for s in states),
             pvalid, part, num_partitions=n, per_dest=per_dest)
-        # stage 2: merge-final aggregation of received partial states
+        # merge-final aggregation of received partial states
         key_ops: List = []
         for i, t in enumerate(key_types):
             key_ops.extend(group_operands(ex_cols[i], ex_nulls[i], t))
@@ -121,12 +180,25 @@ def q1_mesh_fn(mesh: Mesh, proc, step, aggs, per_dest: int):
                 tuple(x[None] for x in fin_nulls),
                 out_valid[None], overflow[None])
 
-    return jax.jit(dist)
+    def exchanged(kr, kn, states, pvalid, part):
+        jit_stats.bump("mesh_q1_exchange_final")
+        return dist(kr, kn, states, pvalid, part)
+
+    return jax.jit(exchanged)
 
 
 def run_q1_mesh(devices: Sequence, schema: str = "micro",
-                per_dest: int = 16, max_per_dest: int = 1 << 16):
+                per_dest: Optional[int] = None,
+                max_per_dest: int = 1 << 16,
+                stats_out: Optional[dict] = None):
     """Execute distributed q1 over the mesh.
+
+    ``per_dest=None`` (default) sizes the exchange count-first: stage 1
+    reports the exact max lane load and the data collective runs ONCE,
+    zero retries by construction. Passing ``per_dest`` pins the legacy
+    guess (tests use per_dest=1 to exercise the doubling backstop).
+    ``stats_out``, when given, is filled with the exchange's skew stats
+    (partition_rows, skew_ratio, per_dest, retries, collectives).
 
     Returns (result_rows, n_overflow_retries, connector, scanned_pages) —
     the latter two so callers can re-run the same data locally for the
@@ -142,18 +214,41 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
     cols, nulls, valid = _shard_page(whole, n)
     types = [b.type for b in whole.blocks]
     dicts = [b.dictionary for b in whole.blocks]
-    proc, step = q1_device_step(types)
-    from ..benchmarks import q1_expressions
+    tsig = tuple(map(str, types))
 
-    _, _, aggs = q1_expressions(types)
+    def _build_q1_programs():
+        from ..benchmarks import q1_expressions
+
+        proc, step = q1_device_step(types)
+        _, _, aggs = q1_expressions(types)
+        return proc, step, aggs
+
+    # memoized per type signature: a fresh proc/step per call would
+    # rebuild the per-instance jit caches and re-trace every repeat run
+    proc, step, aggs = _cached_program(("q1_step", tsig),
+                                       _build_q1_programs)
     luts = proc._fill_luts(dicts)
 
+    s1 = _cached_program(("stage1", mesh, tsig),
+                         lambda: q1_stage1_fn(mesh, proc, step))
+    kr, kn, states, pvalid, part, hist, need = s1(
+        tuple(cols), tuple(nulls), valid, luts)
+    part_rows = np.asarray(hist)[0]
+    exact_need = int(np.asarray(need)[0])
+    sizing = "exact" if per_dest is None else "legacy"
+    if per_dest is None:
+        per_dest = padded_size(max(exact_need, 16))
+
     retries = 0
+    collectives = 0
     while True:
-        fn = q1_mesh_fn(mesh, proc, step, aggs, per_dest)
+        fn = _cached_program(
+            ("final", mesh, tsig, per_dest),
+            lambda: q1_exchange_final_fn(mesh, proc, aggs, per_dest))
         out_cols, out_nulls, out_valid, overflow = fn(
-            tuple(cols), tuple(nulls), valid, luts)
+            kr, kn, states, pvalid, part)
         jax.block_until_ready(out_valid)
+        collectives += 1
         if int(np.asarray(overflow).sum()) == 0:
             break
         per_dest *= 2
@@ -161,6 +256,18 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
         if per_dest > max_per_dest:
             raise RuntimeError(
                 f"exchange overflow persists at per_dest={per_dest}")
+
+    if stats_out is not None:
+        mean_rows = float(part_rows.mean()) if n else 0.0
+        stats_out.update({
+            "kind": "device", "sizing": sizing, "per_dest": per_dest,
+            "observed_max_pair_rows": exact_need,
+            "a2a_retries": retries, "data_collectives": collectives,
+            "rows": int(part_rows.sum()),
+            "partition_rows": [int(r) for r in part_rows],
+            "skew_ratio": (round(float(part_rows.max()) / mean_rows, 3)
+                           if mean_rows > 0 else 0.0),
+        })
 
     # assemble the distributed result: compact valid lanes per device
     out_types = list(proc.output_types[:2]) + [a.output_type for a in aggs]

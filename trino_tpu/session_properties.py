@@ -130,6 +130,17 @@ register(SessionProperty(
     "Run hash exchanges between co-resident stages as an all_to_all "
     "device collective over the mesh (falls back to the host path when "
     "tasks outnumber devices or types are host-only)"))
+register(SessionProperty(
+    "device_exchange_sizing", "varchar", "history",
+    "How the device collective picks its all_to_all lane capacity "
+    "(per_dest): EXACT = count-first pass (tiny counting collective, "
+    "zero overflow retries by construction); HISTORY = EWMA of observed "
+    "loads per exchange shape pre-sizes repeat shapes and skips the "
+    "count pass, falling back to EXACT until confident; LEGACY = "
+    "capacity guess with the doubling-retry overflow protocol (the 2x "
+    "re-shuffle cliff under skew)",
+    lambda v: v in ("exact", "history", "legacy"),
+    normalize=str.lower))
 
 
 def _parse(prop: SessionProperty, raw):
