@@ -166,6 +166,43 @@ def _measure_child():
         }), flush=True)
 
 
+def _chaos_smoke(n_workers: int = 2, seed: int = 7) -> dict:
+    """BENCH_ROLE=chaos: deterministic fault-injection smoke over the
+    multi-process runtime — kill a worker mid-query under
+    retry_policy=TASK and assert the answer matches the fault-free run,
+    so the recovery code paths (taxonomy, retry-from-spool, worker
+    replacement) cannot silently rot outside the test suite. Returns
+    the result dict (also printed as a CHAOS_RESULT line)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from trino_tpu.parallel.process_runner import ProcessQueryRunner
+    from trino_tpu.sql.analyzer import Session
+
+    sql = ("select l_returnflag, l_linestatus, count(*), "
+           "sum(l_quantity) from lineitem "
+           "group by l_returnflag, l_linestatus")
+    s = Session(catalog="tpch", schema="micro")
+    s.properties["streaming_execution"] = False
+    s.properties["retry_policy"] = "TASK"
+    with ProcessQueryRunner(
+            {"tpch": {"connector": "tpch", "page_rows": 4096}}, s,
+            n_workers=n_workers, desired_splits=4,
+            heartbeat_interval=0.25) as c:
+        c.fault_schedule.seed = seed
+        clean = sorted(c.execute(sql).rows)
+        qid = f"q{c._task_seq + 1}a0"
+        c.fault_schedule.add(f"{qid}.f1", "kill-worker")
+        res = c.execute(sql)
+        out = {
+            "ok": sorted(res.rows) == clean,
+            "recovery": res.stats["recovery"],
+            "workers_alive": c.heal(),
+        }
+    print("CHAOS_RESULT " + json.dumps(out), flush=True)
+    if not out["ok"]:
+        raise SystemExit(4)
+    return out
+
+
 # ---------------------------------------------------------------- parent ----
 
 def _guarded_child_cls():
@@ -378,5 +415,7 @@ def main():
 if __name__ == "__main__":
     if os.environ.get("BENCH_ROLE") == "measure":
         _measure_child()
+    elif os.environ.get("BENCH_ROLE") == "chaos":
+        _chaos_smoke()
     else:
         main()

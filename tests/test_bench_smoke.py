@@ -130,6 +130,27 @@ def test_bench_child_init_watchdog_fails_fast():
 
 
 @pytest.mark.slow
+def test_bench_chaos_smoke_child():
+    """The bench harness's chaos role (BENCH_ROLE=chaos): a seeded
+    kill-worker fault under retry_policy=TASK must recover to the exact
+    fault-free answer and report its recovery counters — run as the real
+    child process so the fault-injection code paths cannot rot outside
+    the test suite."""
+    env = dict(os.environ, BENCH_ROLE="chaos", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [line for line in proc.stdout.splitlines()
+             if line.startswith("CHAOS_RESULT ")]
+    assert len(lines) == 1, proc.stdout[-2000:]
+    out = json.loads(lines[0][len("CHAOS_RESULT "):])
+    assert out["ok"] is True
+    assert out["recovery"]["task_retries"] >= 1
+    assert out["workers_alive"] == [True, True]
+
+
+@pytest.mark.slow
 def test_bench_measure_child_micro_cpu():
     env = dict(os.environ, BENCH_ROLE="measure", BENCH_PLATFORM="cpu",
                BENCH_SCHEMA="micro", BENCH_QUERIES="q1,q18",

@@ -1,0 +1,400 @@
+"""Seeded chaos matrix over the self-healing multi-process runtime.
+
+Reference analog: ``testing/BaseFailureRecoveryTest.java`` — every fault
+shape the deterministic ``FaultSchedule`` can inject (worker kill, RPC
+drop mid-frame, straggler delay, spool truncation, fail-after-publish,
+injected user error) is driven against TPC-H q1/q3 style queries under
+the retry policies that can recover from it, asserting:
+
+- results equal the fault-free run on the SAME cluster (and the local
+  oracle) — recovery must never change answers;
+- ``task_launches`` match the expected attempt shape (no silent
+  double-launch, no producer re-runs under retry-from-spool);
+- USER errors fail fast with ZERO retry attempts;
+- dead workers get REPLACED (spawn + register + replica re-sync) and
+  the replacement serves subsequent queries.
+
+All cases run 2 workers on the micro schema to stay far under the ~10 s
+per-case tier-1 budget rule.
+"""
+
+import time
+
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.events import EventListener
+from trino_tpu.parallel.fault import FaultSchedule
+from trino_tpu.parallel.process_runner import ProcessQueryRunner
+from trino_tpu.resources.tpch_queries import TPCH_QUERIES
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+from trino_tpu.types import TrinoError
+
+CATALOGS = {"tpch": {"connector": "tpch", "page_rows": 4096},
+            "memory": {"connector": "memory"}}
+Q1 = ("select l_returnflag, l_linestatus, count(*), sum(l_quantity) "
+      "from lineitem group by l_returnflag, l_linestatus")
+Q3 = TPCH_QUERIES[3]
+
+
+class _Recorder(EventListener):
+    def __init__(self):
+        self.replaced = []
+        self.retries = []
+
+    def worker_replaced(self, event):
+        self.replaced.append(event)
+
+    def task_retry(self, event):
+        self.retries.append(event)
+
+
+RECORDER = _Recorder()
+
+
+def _mk_session(**props):
+    s = Session(catalog="tpch", schema="micro")
+    s.properties.update({"retry_initial_backoff": 0.02,
+                         "retry_max_backoff": 0.2, **props})
+    return s
+
+
+@pytest.fixture(scope="module")
+def local():
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=4096)},
+                            Session(catalog="tpch", schema="micro"))
+
+
+@pytest.fixture(scope="module")
+def task_cluster():
+    """retry_policy=TASK over the spooled barrier shape — the full
+    fault-tolerant stack: retry-from-spool, speculation, replacement."""
+    # speculation off by default in this module: a cold replacement
+    # worker's first-task warmup (seconds) would otherwise let a
+    # legitimate speculative win rescue a faulted task BEFORE the
+    # task-retry path each test means to pin down; the dedicated
+    # straggler test re-enables it
+    s = _mk_session(streaming_execution=False, retry_policy="TASK",
+                    speculative_execution_enabled=False,
+                    speculation_min_seconds=0.3)
+    with ProcessQueryRunner(CATALOGS, s, n_workers=2, desired_splits=4,
+                            broadcast_threshold=300.0,
+                            heartbeat_interval=0.25,
+                            event_listeners=[RECORDER]) as c:
+        c.fault_schedule = FaultSchedule(seed=42)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def barrier_cluster():
+    """retry_policy=QUERY, streaming off: barrier stages whose results
+    are pulled over get_results — the transient-RPC-retry seam."""
+    s = _mk_session(streaming_execution=False, retry_policy="QUERY")
+    with ProcessQueryRunner(CATALOGS, s, n_workers=2, desired_splits=4,
+                            broadcast_threshold=300.0,
+                            heartbeat_interval=0.25) as c:
+        c.fault_schedule = FaultSchedule(seed=42)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def stream_cluster():
+    """retry_policy=QUERY, streaming on (the default shape): outputs
+    are not durable, every fault recovers via full-query retry."""
+    s = _mk_session(retry_policy="QUERY")
+    with ProcessQueryRunner(CATALOGS, s, n_workers=2, desired_splits=4,
+                            broadcast_threshold=300.0,
+                            heartbeat_interval=0.25) as c:
+        c.fault_schedule = FaultSchedule(seed=42)
+        yield c
+
+
+def _await_capacity(c, timeout=90):
+    """Wait for self-healing to restore every worker slot."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(c.heal()):
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"cluster never healed: {c.heartbeat()}")
+
+
+def _next_qid(c):
+    return f"q{c._task_seq + 1}a0"
+
+
+def _launches_since(c, mark):
+    return c.task_launches[mark:]
+
+
+# ----------------------------------------------------------- TASK policy ----
+
+
+def test_task_clean_baselines(local, task_cluster):
+    """Fault-free anchors (also warms the per-cluster compile caches so
+    later straggler medians are tight)."""
+    c = task_cluster
+    c._q1_clean = sorted(c.execute(Q1).rows)
+    c._q3_clean = c.execute(Q3).rows
+    assert c._q1_clean == sorted(local.execute(Q1).rows)
+    assert c._q3_clean == local.execute(Q3).rows
+
+
+def test_kill_worker_mid_query_task_policy(task_cluster):
+    """THE acceptance scenario: a seeded FaultSchedule kills a worker
+    mid-query under TASK policy — correct results, completed producer
+    stages NOT re-run (task_launches), all recovery inside attempt 0,
+    and the replacement worker serves the next query."""
+    c = task_cluster
+    _await_capacity(c)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f1", "kill-worker")
+    mark = len(c.task_launches)
+    res = c.execute(Q1)
+    assert sorted(res.rows) == c._q1_clean
+    launches = _launches_since(c, mark)
+    assert all("a0." in t for t in launches), launches
+    f0 = [t for t in launches if f"{qid}.f0." in t]
+    f1 = [t for t in launches if f"{qid}.f1." in t]
+    assert len(f0) == 2, f"producer stage re-ran: {f0}"
+    assert len(f1) == 3, f"expected exactly one retried task: {f1}"
+    rec = res.stats["recovery"]
+    assert rec["task_retries"] == 1
+    assert rec["retries_by_type"].get("EXTERNAL") == 1
+    assert rec["query_retries"] == 0
+    # self-healing: the killed slot comes back and serves queries
+    _await_capacity(c)
+    assert sorted(c.execute(Q1).rows) == c._q1_clean
+
+
+def test_kill_worker_q3_join_task_policy(task_cluster):
+    """Same fault against the join+TopN pipeline (more fragments, merge
+    output): recovery stays inside attempt 0."""
+    c = task_cluster
+    _await_capacity(c)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f1", "kill-worker")
+    mark = len(c.task_launches)
+    res = c.execute(Q3)
+    assert res.rows == c._q3_clean
+    launches = _launches_since(c, mark)
+    assert all("a0." in t for t in launches), launches
+    _await_capacity(c)
+
+
+def test_fail_after_spool_publish_first_publish_wins(task_cluster):
+    """A task that fails AFTER publishing its spool output retries; the
+    duplicate publish is discarded (first-publish-wins hard link) and
+    results stay exact."""
+    c = task_cluster
+    _await_capacity(c)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f0", "fail-after-publish")
+    mark = len(c.task_launches)
+    res = c.execute(Q1)
+    assert sorted(res.rows) == c._q1_clean
+    launches = _launches_since(c, mark)
+    assert all("a0." in t for t in launches), launches
+    assert any(".r1" in t for t in launches
+               if f"{qid}.f0." in t), launches
+    assert res.stats["recovery"]["retries_by_type"].get("INTERNAL") == 1
+
+
+def test_truncate_spool_frame_query_retry(task_cluster):
+    """A torn spool file must fail loudly (never partial rows); a task
+    retry re-reads the same bytes, so recovery comes from the QUERY
+    retry rebuilding the exchange under a fresh attempt id."""
+    c = task_cluster
+    _await_capacity(c)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f0", "truncate-spool")
+    mark = len(c.task_launches)
+    res = c.execute(Q1)
+    assert sorted(res.rows) == c._q1_clean
+    launches = _launches_since(c, mark)
+    assert any("a1." in t for t in launches), launches
+    assert res.stats["recovery"]["query_retries"] >= 1
+
+
+def test_straggler_speculative_redispatch(task_cluster):
+    """A task delayed far past its sibling's median is re-dispatched on
+    another worker; the speculative attempt wins and the query never
+    waits out the straggler's full delay."""
+    c = task_cluster
+    _await_capacity(c)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f0", "delay", delay_s=4.0)
+    mark = len(c.task_launches)
+    c.session.properties["speculative_execution_enabled"] = True
+    try:
+        res = c.execute(Q1)
+    finally:
+        c.session.properties["speculative_execution_enabled"] = False
+    assert sorted(res.rows) == c._q1_clean
+    launches = _launches_since(c, mark)
+    assert any(t.endswith(".spec") for t in launches), launches
+    rec = res.stats["recovery"]
+    assert rec["speculative_launched"] >= 1
+    assert rec["speculative_wins"] >= 1
+    assert rec["query_retries"] == 0
+    assert all("a0." in t for t in launches), launches
+
+
+def test_user_error_is_never_retried(task_cluster):
+    """A USER-typed failure (deterministic) fails the query fast: zero
+    task retries, zero query retries, and the TrinoError names the real
+    remote failure including its traceback."""
+    c = task_cluster
+    _await_capacity(c)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f0", "user-error")
+    mark = len(c.task_launches)
+    before = (c.recovery_total.task_retries,
+              c.recovery_total.query_retries)
+    with pytest.raises(TrinoError) as ei:
+        c.execute(Q1)
+    assert ei.value.code == "DIVISION_BY_ZERO"
+    assert "injected user error" in str(ei.value)
+    assert "remote traceback" in str(ei.value)
+    launches = _launches_since(c, mark)
+    assert not any(".r1" in t or ".spec" in t or "a1." in t
+                   for t in launches), launches
+    assert (c.recovery_total.task_retries,
+            c.recovery_total.query_retries) == before
+
+
+def test_query_deadline_enforced_as_user_error(task_cluster):
+    """query_max_run_time caps the query across all RPCs and raises
+    EXCEEDED_TIME_LIMIT — classified USER, so no retry burns the
+    remaining budget on a doomed query."""
+    c = task_cluster
+    _await_capacity(c)
+    mark = len(c.task_launches)
+    c.session.properties["query_max_run_time"] = 0.001
+    try:
+        with pytest.raises(TrinoError) as ei:
+            c.execute(Q1)
+    finally:
+        del c.session.properties["query_max_run_time"]
+    assert ei.value.code == "EXCEEDED_TIME_LIMIT"
+    launches = _launches_since(c, mark)
+    assert not any("a1." in t for t in launches), launches
+
+
+def test_worker_replacement_resyncs_replicated_tables(task_cluster):
+    """Replacement is a full re-register: the new process receives the
+    replicated memory-catalog tables, so distributed scans of local
+    replicas stay correct after the swap."""
+    c = task_cluster
+    _await_capacity(c)
+    c.execute("create table memory.default.chaos_t as "
+              "select n_nationkey k, n_name from tpch.micro.nation")
+    victim = c.workers[0]
+    victim.proc.kill()
+    victim.proc.wait(timeout=10)
+    _await_capacity(c)
+    assert c.workers[0].proc.pid != victim.proc.pid
+    res = c.execute("select count(*) from memory.default.chaos_t")
+    assert res.rows == [(25,)]
+    c.execute("drop table memory.default.chaos_t")
+
+
+def test_explain_analyze_surfaces_recovery(task_cluster):
+    """EXPLAIN ANALYZE on the process runner renders the recovery
+    counters (attempts, retries by type, backoff) for a faulted run."""
+    c = task_cluster
+    _await_capacity(c)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f1", "error")
+    res = c.execute("explain analyze " + Q1)
+    text = "\n".join(r[0] for r in res.rows)
+    assert "Recovery:" in text, text
+    assert "task retries" in text
+    assert "INTERNAL=1" in text
+
+
+def test_chaos_events_recorded(task_cluster):
+    """The event listener SPI observed the module's self-healing:
+    replacements and typed retries fanned out to listeners."""
+    assert any(e.new_pid != e.old_pid for e in RECORDER.replaced)
+    assert any(e.error_type == "EXTERNAL" for e in RECORDER.retries)
+    assert any(e.speculative for e in RECORDER.retries)
+
+
+# ---------------------------------------------------------- QUERY policy ----
+
+
+def test_rpc_drop_mid_frame_recovers_in_place(local, barrier_cluster):
+    """A connection torn mid-frame during a result pull is retried at
+    the transport layer (each get_results response is an independent
+    snapshot): NO task relaunch, NO query retry — zero extra launches
+    vs the fault-free run."""
+    c = barrier_cluster
+    mark0 = len(c.task_launches)
+    clean = sorted(c.execute(Q1).rows)
+    assert clean == sorted(local.execute(Q1).rows)
+    clean_count = len(c.task_launches) - mark0
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f1", "drop-connection")
+    mark = len(c.task_launches)
+    res = c.execute(Q1)
+    assert sorted(res.rows) == clean
+    launches = _launches_since(c, mark)
+    # identical attempt shape to the fault-free run: no silent
+    # double-launch anywhere
+    assert len(launches) == clean_count, (launches, clean_count)
+    assert not any(".r1" in t or "a1." in t for t in launches), launches
+    assert res.stats["recovery"]["retries_by_type"].get(
+        "EXTERNAL", 0) >= 1
+    assert res.stats["recovery"]["query_retries"] == 0
+
+
+def test_kill_worker_streaming_query_retry(local, stream_cluster):
+    """Streaming outputs are not durable: a killed worker loses them,
+    the query retries wholesale on the healed cluster, answers stay
+    exact."""
+    c = stream_cluster
+    clean = sorted(c.execute(Q1).rows)
+    assert clean == sorted(local.execute(Q1).rows)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f0", "kill-worker")
+    mark = len(c.task_launches)
+    res = c.execute(Q1)
+    assert sorted(res.rows) == clean
+    launches = _launches_since(c, mark)
+    assert any("a1." in t for t in launches), launches
+    assert res.stats["recovery"]["query_retries"] >= 1
+    _await_capacity(c)
+
+
+def test_rpc_drop_streaming_query_retry(stream_cluster):
+    """A mid-frame drop on the streaming pull: the drain cursor already
+    advanced server-side, so in-place reconnect would silently lose
+    pages — the channel classifies it connection-lost and the query
+    retries."""
+    c = stream_cluster
+    _await_capacity(c)
+    clean = sorted(c.execute(Q1).rows)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f0", "drop-connection")
+    res = c.execute(Q1)
+    assert sorted(res.rows) == clean
+    assert res.stats["recovery"]["query_retries"] >= 1
+
+
+def test_user_error_fails_fast_streaming(stream_cluster):
+    """The taxonomy propagates transitively through streaming pulls:
+    a USER error in a mid-plan task surfaces as the original error with
+    zero query retries."""
+    c = stream_cluster
+    _await_capacity(c)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f0", "user-error")
+    mark = len(c.task_launches)
+    with pytest.raises(TrinoError) as ei:
+        c.execute(Q1)
+    assert ei.value.code == "DIVISION_BY_ZERO"
+    assert "injected user error" in str(ei.value)
+    launches = _launches_since(c, mark)
+    assert not any("a1." in t for t in launches), launches

@@ -91,16 +91,28 @@ def test_injected_task_failure_recovers(local, cluster):
     assert not any(cluster.failure_injections.values())
 
 
-def test_worker_death_query_retry(local, cluster):
-    """Killing a worker mid-cluster: heartbeat marks it dead and the
-    query retries on the survivors."""
+def test_worker_death_query_retry_and_replacement(local, cluster):
+    """Killing a worker mid-cluster: the query retries on survivors AND
+    the self-healing path replaces the dead worker, so capacity recovers
+    instead of decaying (round-6 tentpole)."""
     victim = cluster.workers[1]
     victim.proc.kill()
     victim.proc.wait(timeout=10)
     sql = "select count(*), sum(l_quantity) from lineitem"
     res = cluster.execute(sql)
     assert res.rows == local.execute(sql).rows
-    assert cluster.heartbeat() == [True, False]
+    # the on-demand heal (or the background heartbeat loop) swapped in
+    # a replacement process: same slot, bumped generation, alive again
+    assert cluster.heal() == [True, True]
+    assert cluster.workers[1].generation >= 1
+    assert cluster.workers[1].proc.pid != victim.proc.pid
+    # no query_retries assertion: the background monitor may mark the
+    # victim dead before the query ever schedules onto it, in which
+    # case the survivors answer with zero retries — both paths are
+    # correct (the deterministic retry counting lives in test_chaos.py)
+    # the replacement serves queries as a first-class worker
+    res2 = cluster.execute(sql)
+    assert res2.rows == res.rows
 
 
 def test_streaming_cross_process_overlap(cluster):

@@ -39,6 +39,31 @@ class QueryCompletedEvent:
         return (self.end_time - self.create_time) * 1e3
 
 
+@dataclass(frozen=True)
+class WorkerReplacedEvent:
+    """A dead worker was detected and a replacement spawned, registered
+    and re-synced (the self-healing seam of the process runtime)."""
+
+    worker_index: int
+    old_pid: Optional[int]
+    new_pid: int
+    reason: str                     # heartbeat | on-demand
+    time: float
+
+
+@dataclass(frozen=True)
+class TaskRetryEvent:
+    """A task or query attempt was retried (or speculatively
+    re-dispatched) after a classified failure."""
+
+    task_id: str
+    error_type: str                 # fault.ERROR_TYPES
+    attempt: int
+    speculative: bool
+    query_level: bool
+    time: float
+
+
 class EventListener:
     """Subclass hooks (reference: spi/eventlistener/EventListener.java)."""
 
@@ -46,6 +71,12 @@ class EventListener:
         pass
 
     def query_completed(self, event: QueryCompletedEvent):
+        pass
+
+    def worker_replaced(self, event: WorkerReplacedEvent):
+        pass
+
+    def task_retry(self, event: TaskRetryEvent):
         pass
 
 
@@ -72,6 +103,20 @@ class EventListenerManager:
         for listener in self.listeners:
             try:
                 listener.query_completed(event)
+            except Exception:
+                pass
+
+    def fire_worker_replaced(self, event: WorkerReplacedEvent):
+        for listener in self.listeners:
+            try:
+                listener.worker_replaced(event)
+            except Exception:
+                pass
+
+    def fire_task_retry(self, event: TaskRetryEvent):
+        for listener in self.listeners:
+            try:
+                listener.task_retry(event)
             except Exception:
                 pass
 

@@ -93,13 +93,42 @@ class QueryStatsTree:
     stages: List[StageStatsTree] = field(default_factory=list)
     wall_ms: float = 0.0
     memory: Optional[Dict] = None
+    #: self-healing counters for this query (fault.RecoveryStats dict):
+    #: attempts, retries by error type, backoff wall-time, workers
+    #: replaced, speculative launches/wins — attached by the process
+    #: runner so EXPLAIN ANALYZE and the bench surface recovery
+    recovery: Optional[Dict] = None
 
     def to_dict(self) -> dict:
         return {
             "wall_ms": round(self.wall_ms, 2),
             "memory": self.memory,
+            "recovery": self.recovery,
             "stages": [s.to_dict() for s in self.stages],
         }
+
+    def recovery_line(self) -> Optional[str]:
+        """One EXPLAIN ANALYZE line summarizing what self-healing did;
+        None when the query saw no faults (keep clean plans clean)."""
+        r = self.recovery
+        if not r:
+            return None
+        interesting = (r.get("task_retries", 0) or
+                       r.get("query_retries", 0) or
+                       r.get("workers_replaced", 0) or
+                       r.get("speculative_launched", 0))
+        if not interesting:
+            return None
+        by_type = ", ".join(f"{k}={v}" for k, v in
+                            sorted(r.get("retries_by_type", {}).items()))
+        return (f"Recovery: {r.get('task_attempts', 0)} task attempts, "
+                f"{r.get('task_retries', 0)} task retries + "
+                f"{r.get('query_retries', 0)} query retries"
+                + (f" [{by_type}]" if by_type else "")
+                + f", backoff {r.get('backoff_wall_s', 0.0):.2f}s, "
+                f"workers replaced {r.get('workers_replaced', 0)}, "
+                f"speculative {r.get('speculative_wins', 0)}/"
+                f"{r.get('speculative_launched', 0)} won")
 
     def render(self) -> List[str]:
         """EXPLAIN ANALYZE text: stages top-down with per-task operator
@@ -112,6 +141,9 @@ class QueryStatsTree:
                 f"Memory: peak {self.memory.get('peak_bytes', 0)} bytes, "
                 f"{self.memory.get('spill_events', 0)} spills "
                 f"({self.memory.get('spilled_bytes', 0)} bytes)")
+        rec_line = self.recovery_line()
+        if rec_line:
+            lines.append(rec_line)
         for s in sorted(self.stages, key=lambda s: -s.stage_id):
             total_rows = sum(t.output_rows for t in s.tasks)
             lines.append(

@@ -20,17 +20,37 @@ Round-5 shape: a real MPP engine —
 - DISTRIBUTED writes: INSERT/CTAS writer tasks run on the workers and
   ship written pages to the coordinator's catalog over the page-sink
   RPC; commits replicate the table to every worker (replicated memory
-  storage), so subsequent distributed scans read local replicas
-  (reference: operator/TableWriterOperator.java + the memory plugin's
-  worker-resident MemoryPagesStore);
+  storage), so subsequent distributed scans read local replicas;
 - barrier mode (session ``streaming_execution=false``): stage-by-stage
   with whole-output buffering and task-level retry on another worker.
+
+Round-6 shape: SELF-HEALING fault tolerance —
+- worker replacement: a background heartbeat loop (and the on-demand
+  heal on worker loss) detects dead workers, respawns a replacement
+  process, re-registers it and re-syncs replicated tables, so capacity
+  recovers instead of decaying to "no live workers";
+- failure taxonomy: every task/RPC failure carries a USER / INTERNAL /
+  EXTERNAL / INSUFFICIENT_RESOURCES type plus the remote traceback
+  (parallel/fault.py); USER errors fail fast with ZERO retries, only
+  infrastructure faults consume the retry budget;
+- deadlines + backoff: ``query_max_run_time`` caps every
+  coordinator->worker RPC, ``rpc_request_timeout`` replaces the old
+  hardwired 600 s, and query/task retries use seeded exponential
+  backoff inside a per-query attempt budget (``retry_max_attempts``);
+- speculative stragglers: under retry_policy=TASK a task running far
+  past the median of its completed siblings is re-dispatched on another
+  worker — the spool's first-publish-wins rename makes the duplicate
+  safe;
+- deterministic chaos: ``FaultSchedule`` injects kill-worker /
+  drop-connection / delay / fail-after-publish / truncate-spool faults
+  by (task-id pattern, occurrence), seeded for exact replay.
 """
 
 from __future__ import annotations
 
 import os
 import socketserver
+import statistics
 import subprocess
 import sys
 import threading
@@ -39,22 +59,31 @@ import traceback
 from typing import Dict, List, Optional, Tuple
 
 from .. import session_properties as SP
+from .. import types as T
 from ..block import Page
+from ..events import (EventListenerManager, TaskRetryEvent,
+                      WorkerReplacedEvent)
 from ..exec.serde import PageDeserializer, PageSerializer
+from ..exec.stats import QueryStatsTree
 from ..planner.fragmenter import PlanFragment
 from ..runner import QueryResult
 from ..sql import ast
 from ..sql.analyzer import Session
 from ..sql.parser import parse_statement
 from ..types import TrinoError
+from .fault import (EXTERNAL, INSUFFICIENT_RESOURCES, INTERNAL, USER,
+                    BackoffPolicy, Deadline, FaultSchedule, RecoveryStats,
+                    RemoteTaskError, classify_error_code)
 from .rpc import call, fetch_pages, recv_msg, send_msg
 
 
 class WorkerHandle:
-    def __init__(self, proc: subprocess.Popen, addr: Tuple[str, int]):
+    def __init__(self, proc: subprocess.Popen, addr: Tuple[str, int],
+                 generation: int = 0):
         self.proc = proc
         self.addr = addr
         self.alive = True
+        self.generation = generation   # bumps on replacement
         #: replication cursors: (catalog, schema, table) -> number of
         #: committed pages this worker's replica already holds, so
         #: append-only commits ship only the tail (not O(N^2) re-sends)
@@ -62,6 +91,30 @@ class WorkerHandle:
 
     def rpc(self, request: dict, timeout: float = 600.0) -> dict:
         return call(self.addr, request, timeout=timeout)
+
+
+class _QueryCtx:
+    """Per-query retry/deadline state threaded through one execution:
+    call-local so concurrent queries cannot perturb each other."""
+
+    def __init__(self, session: Session, seed_id: str):
+        self.deadline = Deadline(SP.value(session, "query_max_run_time"))
+        self.rpc_timeout = float(SP.value(session, "rpc_request_timeout"))
+        self.backoff = BackoffPolicy(
+            initial=SP.value(session, "retry_initial_backoff"),
+            maximum=SP.value(session, "retry_max_backoff"),
+            seed=BackoffPolicy.seed_for(seed_id))
+        self.recovery = RecoveryStats()
+        self.spec_enabled = SP.value(session,
+                                     "speculative_execution_enabled")
+        self.spec_multiplier = SP.value(session, "speculation_multiplier")
+        self.spec_min_s = SP.value(session, "speculation_min_seconds")
+
+    def timeout(self, base: Optional[float] = None) -> float:
+        """RPC timeout capped by the query deadline (raises
+        EXCEEDED_TIME_LIMIT once the deadline passed)."""
+        return self.deadline.rpc_timeout(
+            self.rpc_timeout if base is None else base)
 
 
 class _CoordinatorService:
@@ -108,7 +161,10 @@ class ProcessQueryRunner:
                  session: Optional[Session] = None,
                  n_workers: int = 2, desired_splits: int = 8,
                  broadcast_threshold: Optional[float] = None,
-                 task_retries: int = 1):
+                 task_retries: int = 1,
+                 heartbeat_interval: Optional[float] = 5.0,
+                 worker_replacement: bool = True,
+                 event_listeners: Optional[list] = None):
         from ..connectors.catalog import create_catalogs
         from ..planner.logical_planner import Metadata
 
@@ -129,7 +185,9 @@ class ProcessQueryRunner:
         self._sink_streams: Dict[tuple, PageDeserializer] = {}
         self._stage_lock = threading.Lock()
         self.workers: List[WorkerHandle] = []
-        self.failure_injections: Dict[str, int] = {}  # task prefix -> n
+        #: deterministic chaos harness (generalizes the seed's one-shot
+        #: inject_task_failure); armed faults ride along run_task
+        self.fault_schedule = FaultSchedule()
         #: every task attempt launched (test observability: retry-from-
         #: spool asserts producer stages launch exactly once)
         self.task_launches: List[str] = []
@@ -140,53 +198,77 @@ class ProcessQueryRunner:
         # commits push replicas out
         self._replicated = {name for name, c in catalogs.items()
                             if c.get("connector", name) == "memory"}
+        #: cumulative self-healing counters across all queries + the
+        #: background monitor (per-query deltas ride QueryResult.stats)
+        self.recovery_total = RecoveryStats()
+        self.event_manager = EventListenerManager(
+            list(event_listeners or ()))
+        self.worker_replacement = worker_replacement
+        self.heartbeat_interval = heartbeat_interval
+        self._heal_lock = threading.Lock()
+        self._closed = threading.Event()
         self.service = _CoordinatorService(self)
         self._spawn_workers()
+        self._monitor_thread = None
+        if heartbeat_interval is not None and worker_replacement:
+            self._monitor_thread = threading.Thread(
+                target=self._monitor_loop, daemon=True)
+            self._monitor_thread.start()
 
     # -- cluster lifecycle ----------------------------------------------
 
-    def _spawn_workers(self):
+    def _spawn_worker_process(self, generation: int = 0) -> WorkerHandle:
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    JAX_COMPILATION_CACHE_DIR="/tmp/trino_tpu_jax_cache")
         env.pop("XLA_FLAGS", None)  # workers need no virtual mesh
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "trino_tpu.parallel.worker"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            env=env, cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            text=True)
+        line = ""
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("WORKER_READY"):
+                break
+            if line == "" or proc.poll() is not None:
+                break  # EOF: the worker died during startup
+        if not line.startswith("WORKER_READY"):
+            raise TrinoError("worker failed to start",
+                             "GENERIC_INTERNAL_ERROR")
+        port = int(line.split()[1])
+        handle = WorkerHandle(proc, ("127.0.0.1", port), generation)
+        handle.rpc({"op": "configure",
+                    "catalogs": self.catalog_config,
+                    "properties": dict(self.session.properties)},
+                   timeout=60)
+        return handle
+
+    def _spawn_workers(self):
         for _ in range(self.n_workers):
-            proc = subprocess.Popen(
-                [sys.executable, "-m", "trino_tpu.parallel.worker"],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                env=env, cwd=os.path.dirname(os.path.dirname(
-                    os.path.dirname(os.path.abspath(__file__)))),
-                text=True)
-            line = ""
-            deadline = time.time() + 120
-            while time.time() < deadline:
-                line = proc.stdout.readline()
-                if line.startswith("WORKER_READY"):
-                    break
-                if line == "" or proc.poll() is not None:
-                    break  # EOF: the worker died during startup
-            if not line.startswith("WORKER_READY"):
-                raise TrinoError("worker failed to start",
-                                 "GENERIC_INTERNAL_ERROR")
-            port = int(line.split()[1])
-            handle = WorkerHandle(proc, ("127.0.0.1", port))
-            handle.rpc({"op": "configure",
-                        "catalogs": self.catalog_config,
-                        "properties": dict(self.session.properties)})
-            self.workers.append(handle)
+            self.workers.append(self._spawn_worker_process())
 
     def close(self):
-        for w in self.workers:
-            try:
-                w.rpc({"op": "shutdown"}, timeout=5)
-            except OSError:
-                pass
-            w.proc.terminate()
-        for w in self.workers:
-            try:
-                w.proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                w.proc.kill()
-        self.workers = []
+        self._closed.set()
+        if self._monitor_thread is not None:
+            self._monitor_thread.join(timeout=10)
+        # serialize with any in-flight replacement (query-path heal):
+        # a spawn finishing after teardown must not orphan a process
+        with self._heal_lock:
+            for w in self.workers:
+                try:
+                    w.rpc({"op": "shutdown"}, timeout=5)
+                except OSError:
+                    pass
+                w.proc.terminate()
+            for w in self.workers:
+                try:
+                    w.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    w.proc.kill()
+            self.workers = []
         self.service.close()
 
     def __enter__(self):
@@ -251,31 +333,51 @@ class ProcessQueryRunner:
         for w in self.workers:
             if not w.alive:
                 continue
-            start = 0 if full else min(w.synced.get(key, 0), len(pages))
-            ser = PageSerializer()  # per-receiver stream
-            frames = [ser.serialize(p) for p in pages[start:]]
-            try:
-                resp = w.rpc({"op": "sync_table", "catalog": catalog,
-                              "schema": schema, "table": table,
-                              "columns": data.columns, "start": start,
-                              "frames": frames})
-                if resp.get("resync"):  # replica diverged: full resend
-                    ser = PageSerializer()
-                    resp = w.rpc({
-                        "op": "sync_table", "catalog": catalog,
-                        "schema": schema, "table": table,
-                        "columns": data.columns, "start": 0,
-                        "frames": [ser.serialize(p) for p in pages]})
-                if resp.get("ok"):
-                    w.synced[key] = len(pages)
-            except OSError:
-                w.alive = False
+            self._sync_worker_table(w, catalog, schema, table,
+                                    data.columns, pages, full=full)
 
-    # -- failure detection ----------------------------------------------
+    def _sync_worker_table(self, w: WorkerHandle, catalog: str,
+                           schema: str, table: str, columns, pages,
+                           full: bool = False):
+        key = (catalog, schema, table)
+        start = 0 if full else min(w.synced.get(key, 0), len(pages))
+        ser = PageSerializer()  # per-receiver stream
+        frames = [ser.serialize(p) for p in pages[start:]]
+        try:
+            resp = w.rpc({"op": "sync_table", "catalog": catalog,
+                          "schema": schema, "table": table,
+                          "columns": columns, "start": start,
+                          "frames": frames})
+            if resp.get("resync"):  # replica diverged: full resend
+                ser = PageSerializer()
+                resp = w.rpc({
+                    "op": "sync_table", "catalog": catalog,
+                    "schema": schema, "table": table,
+                    "columns": columns, "start": 0,
+                    "frames": [ser.serialize(p) for p in pages]})
+            if resp.get("ok"):
+                w.synced[key] = len(pages)
+        except OSError:
+            w.alive = False
+
+    def _sync_worker_replicas(self, w: WorkerHandle):
+        """Full replica push to one (new) worker: every table of every
+        replicated catalog — the re-register half of worker
+        replacement."""
+        for catalog in sorted(self._replicated):
+            conn = self.connectors[catalog]
+            for (schema, table), data in list(conn.tables.items()):
+                with data.lock:
+                    pages = list(data.pages)
+                self._sync_worker_table(w, catalog, schema, table,
+                                        data.columns, pages, full=True)
+
+    # -- failure detection + self-healing --------------------------------
 
     def heartbeat(self) -> List[bool]:
         """Ping every worker (reference: HeartbeatFailureDetector.ping);
-        marks dead workers so scheduling skips them."""
+        marks dead workers so scheduling skips them. Pure probe — use
+        ``heal()`` to also replace the dead."""
         ok = []
         for w in self.workers:
             try:
@@ -286,23 +388,108 @@ class ProcessQueryRunner:
             ok.append(w.alive)
         return ok
 
+    def heal(self, recovery: Optional[RecoveryStats] = None,
+             reason: str = "on-demand") -> List[bool]:
+        """Probe all workers and replace the dead ones (spawn + register
+        + re-sync replicated tables): the self-healing step that keeps
+        cluster capacity from decaying to zero."""
+        self.heartbeat()
+        if self.worker_replacement:
+            with self._heal_lock:
+                for i, w in enumerate(list(self.workers)):
+                    if not w.alive:
+                        self._replace_worker(i, reason, recovery)
+        return [w.alive for w in self.workers]
+
+    def _replace_worker(self, index: int, reason: str,
+                        recovery: Optional[RecoveryStats] = None):
+        """Spawn, register and re-sync a replacement for one dead worker
+        (caller holds _heal_lock). Failures leave the slot dead — the
+        next heal retries."""
+        if self._closed.is_set() or index >= len(self.workers):
+            return  # shutting down: don't spawn into a closed cluster
+        old = self.workers[index]
+        if old.alive:
+            return
+        new = None
+        try:
+            new = self._spawn_worker_process(old.generation + 1)
+            self._sync_worker_replicas(new)
+        except Exception:
+            traceback.print_exc()
+            if new is not None:   # half-registered replacement: reap it
+                try:
+                    new.proc.kill()
+                except OSError:
+                    pass
+            return
+        if self._closed.is_set() or index >= len(self.workers):
+            try:                  # cluster torn down mid-spawn
+                new.proc.kill()
+            except OSError:
+                pass
+            return
+        # swap in-place: query threads iterate self.workers and pick up
+        # the replacement on their next candidate scan
+        self.workers[index] = new
+        try:
+            old.proc.kill()
+        except OSError:
+            pass
+        # count once: query-path replacements reach recovery_total via
+        # the per-query merge; background ones are credited directly
+        if recovery is not None:
+            recovery.incr("workers_replaced")
+        else:
+            self.recovery_total.incr("workers_replaced")
+        self.event_manager.fire_worker_replaced(WorkerReplacedEvent(
+            index, old.proc.pid, new.proc.pid, reason, time.time()))
+
+    def _monitor_loop(self):
+        """Background failure detector: the configurable-interval
+        heartbeat that makes replacement autonomous rather than only
+        retry-path-triggered."""
+        while not self._closed.wait(self.heartbeat_interval):
+            try:
+                self.heal(reason="heartbeat")
+            except Exception:
+                traceback.print_exc()
+
     def inject_task_failure(self, task_prefix: str, times: int = 1):
         """Arm failure injection: the next `times` tasks whose id starts
         with task_prefix fail at the worker (reference:
-        execution/FailureInjector.java:40)."""
-        self.failure_injections[task_prefix] = times
+        execution/FailureInjector.java:40). Kept as the one-shot facade
+        over the generalized FaultSchedule."""
+        self.fault_schedule.add(task_prefix, "error", times)
 
-    def _take_injection(self, task_id: str) -> bool:
-        for prefix, n in list(self.failure_injections.items()):
-            if task_id.startswith(prefix) and n > 0:
-                self.failure_injections[prefix] = n - 1
-                return True
-        return False
+    @property
+    def failure_injections(self) -> Dict[str, int]:
+        """Back-compat view: armed (pattern -> remaining) counts."""
+        return self.fault_schedule.pending()
+
+    def _fire_retry(self, task_id: str, error_type: str, attempt: int,
+                    speculative: bool = False, query_level: bool = False):
+        self.event_manager.fire_task_retry(TaskRetryEvent(
+            task_id, error_type, attempt, speculative, query_level,
+            time.time()))
+
+    def _backoff_sleep(self, ctx: _QueryCtx, attempt: int):
+        """Exponential backoff with deterministic jitter between retry
+        attempts, capped by (and charged against) the query deadline."""
+        delay = ctx.backoff.delay(attempt)
+        rem = ctx.deadline.remaining()
+        if rem is not None:
+            delay = min(delay, max(0.0, rem))
+        time.sleep(delay)
+        ctx.recovery.incr("backoff_wall_s", delay)
 
     # -- statement routing -----------------------------------------------
 
     def execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
+        if isinstance(stmt, ast.Explain) and stmt.analyze and \
+                isinstance(stmt.statement, ast.QueryStatement):
+            return self._explain_analyze(stmt.statement)
         if isinstance(stmt, (ast.QueryStatement, ast.Insert,
                              ast.CreateTableAsSelect)):
             res = self._execute_with_retry(stmt)
@@ -318,9 +505,22 @@ class ProcessQueryRunner:
         self._sync_after_local(stmt)
         return res
 
-    def _write_target(self, stmt) -> Optional[Tuple[str, str, str]]:
-        from ..planner.logical_planner import Metadata
+    def _explain_analyze(self, stmt) -> QueryResult:
+        """Distributed EXPLAIN ANALYZE: run the query through the full
+        retry machinery and render wall time + recovery counters
+        (exec/stats.QueryStatsTree — the reference's QueryStats
+        hierarchy surface)."""
+        t0 = time.perf_counter()
+        res = self._execute_with_retry(stmt)
+        tree = QueryStatsTree(
+            wall_ms=(time.perf_counter() - t0) * 1e3,
+            recovery=(res.stats or {}).get("recovery"))
+        lines = tree.render()
+        lines.append(f"Output: {len(res.rows)} rows")
+        return QueryResult(["Query Plan"], [T.VARCHAR],
+                           [(line,) for line in lines])
 
+    def _write_target(self, stmt) -> Optional[Tuple[str, str, str]]:
         name = stmt.table if isinstance(stmt, (ast.Insert, ast.Delete)) \
             else stmt.name
         catalog, _conn, schema, table = self.metadata.resolve_target(
@@ -346,30 +546,70 @@ class ProcessQueryRunner:
     # -- query execution -------------------------------------------------
 
     def _execute_with_retry(self, stmt) -> QueryResult:
+        ctx = _QueryCtx(self.session, f"q{self._task_seq + 1}")
+        try:
+            return self._retry_loop(stmt, ctx)
+        finally:
+            self.recovery_total.merge(ctx.recovery)
+
+    def _retry_loop(self, stmt, ctx: _QueryCtx) -> QueryResult:
+        """Attempt-budgeted retry with taxonomy-driven decisions:
+        USER errors raise straight through (deterministic — retrying
+        cannot help), everything else consumes the budget with backoff
+        (reference: the faulttolerant scheduler's retry policy)."""
         policy = SP.value(self.session, "retry_policy")
-        attempts = 1 if policy == "NONE" else 2
+        attempts = 1 if policy == "NONE" \
+            else SP.value(self.session, "retry_max_attempts")
         last_error: Optional[Exception] = None
         for attempt in range(attempts):
             qid = self._next_qid(attempt)
             try:
-                res = self._execute_once(stmt, qid)
+                res = self._execute_once(stmt, qid, ctx)
                 self._commit_staged(
                     getattr(res, "_query_tasks", []), qid)
+                res.stats = dict(res.stats or {})
+                res.stats["recovery"] = ctx.recovery.to_dict()
                 return res
             except _WorkerLost as e:
                 self._discard_staged(qid)
                 last_error = e
-                self.heartbeat()
+                if attempt == attempts - 1:
+                    break
+                # self-heal BEFORE deciding whether retry is possible:
+                # replacement restores capacity a bare heartbeat cannot
+                self.heal(ctx.recovery, reason="on-demand")
                 if not any(w.alive for w in self.workers):
                     break
+                ctx.recovery.record_retry(e.error_type, query_level=True)
+                self._fire_retry(qid, e.error_type, attempt,
+                                 query_level=True)
+                self._backoff_sleep(ctx, attempt)
             except _RetryableTaskError as e:
                 # streaming/NONE have no task-level retry (outputs are
-                # not durable); QUERY policy re-runs once, then
-                # surfaces the underlying error
+                # not durable); the query re-runs under the attempt
+                # budget, then surfaces the underlying error
                 self._discard_staged(qid)
                 last_error = e
                 if attempt == attempts - 1:
                     raise TrinoError(str(e), "GENERIC_INTERNAL_ERROR")
+                ctx.recovery.record_retry(e.error_type, query_level=True)
+                self._fire_retry(qid, e.error_type, attempt,
+                                 query_level=True)
+                self._backoff_sleep(ctx, attempt)
+            except TrinoError as e:
+                self._discard_staged(qid)
+                # the taxonomy decides: resource exhaustion is worth a
+                # backed-off re-run; USER and internal coordinator
+                # errors are deterministic — fail fast
+                if classify_error_code(e.code) != INSUFFICIENT_RESOURCES \
+                        or attempt == attempts - 1:
+                    raise
+                last_error = e
+                ctx.recovery.record_retry(INSUFFICIENT_RESOURCES,
+                                          query_level=True)
+                self._fire_retry(qid, INSUFFICIENT_RESOURCES, attempt,
+                                 query_level=True)
+                self._backoff_sleep(ctx, attempt)
             except BaseException:
                 self._discard_staged(qid)
                 raise
@@ -418,19 +658,20 @@ class ProcessQueryRunner:
         fragments = planning.create_fragments(stmt)
         return fragments, planning._root
 
-    def _execute_once(self, stmt, qid: str) -> QueryResult:
+    def _execute_once(self, stmt, qid: str, ctx: _QueryCtx) -> QueryResult:
         fragments, root = self._plan(stmt)
         # TASK retry requires durable stage outputs, i.e. the spooled
         # barrier shape — the reference's fault-tolerant execution also
         # forgoes streaming pipelining under RetryPolicy.TASK
         if SP.value(self.session, "retry_policy") != "TASK" and \
                 SP.value(self.session, "streaming_execution"):
-            return self._execute_streaming(qid, fragments, root)
-        return self._execute_barrier(qid, fragments, root)
+            return self._execute_streaming(qid, fragments, root, ctx)
+        return self._execute_barrier(qid, fragments, root, ctx)
 
     # ----------------------------------------------- streaming mode ----
 
-    def _execute_streaming(self, qid: str, fragments, root) -> QueryResult:
+    def _execute_streaming(self, qid: str, fragments, root,
+                           ctx: _QueryCtx) -> QueryResult:
         """All fragments' tasks start immediately; the coordinator runs
         the output stage in-line, pulling from workers while they run."""
         bound = SP.value(self.session, "exchange_max_pending_pages")
@@ -445,11 +686,11 @@ class ProcessQueryRunner:
                     raise _WorkerLost("no live workers")
                 if frag.output_kind == "output":
                     result_pages = self._run_output_streaming(
-                        frag, root, locations)
+                        frag, root, locations, ctx)
                 else:
                     locations[frag.fragment_id] = self._start_fragment(
                         qid, frag, live, dict(locations), query_tasks,
-                        bound)
+                        bound, ctx)
             overlap = self._collect_overlap(query_tasks)
         finally:
             self._release(query_tasks)
@@ -465,12 +706,14 @@ class ProcessQueryRunner:
 
     def _start_fragment(self, qid: str, frag: PlanFragment,
                         live: List[WorkerHandle], upstream: dict,
-                        query_tasks: List, bound: int) -> dict:
+                        query_tasks: List, bound: int,
+                        ctx: _QueryCtx) -> dict:
         ntasks = 1 if frag.partitioning == "single" else self.n_workers
         results = []
         for t in range(ntasks):
             task_id = f"{qid}.f{frag.fragment_id}.t{t}.s"
             self.task_launches.append(task_id)
+            ctx.recovery.incr("task_attempts")
             worker = live[t % len(live)]
             req = {
                 "op": "run_task", "task_id": task_id,
@@ -484,22 +727,46 @@ class ProcessQueryRunner:
                 "streaming": True, "buffer_bound": bound,
                 "coordinator": self.service.addr,
                 "remote_write_catalogs": sorted(self._replicated),
-                "inject_failure": self._take_injection(task_id),
+                "fault": self.fault_schedule.match(task_id),
             }
             try:
-                resp = worker.rpc(req, timeout=60)
+                # full rpc_request_timeout: the streaming ack is fast on
+                # a healthy worker, and the property must be able to
+                # RAISE the bound on slow hosts, not only lower it
+                resp = worker.rpc(req, timeout=ctx.timeout())
             except OSError:
                 worker.alive = False
                 raise _WorkerLost(f"worker {worker.addr} unreachable")
             if not resp.get("ok"):
-                raise _RetryableTaskError(
-                    resp.get("error", "task failed to start"))
+                raise self._task_error(resp, task_id)
             results.append((worker.addr, task_id))
             query_tasks.append((worker.addr, task_id))
         return {"kind": frag.output_kind, "locations": results}
 
+    @staticmethod
+    def _classify_remote(err: RemoteTaskError) -> Exception:
+        """THE one recovery-decision point for typed remote failures:
+        USER errors become the terminal TrinoError (fail fast, naming
+        the real remote failure); a transport loss the worker observed
+        upstream stays a worker-lost (the retry path must heal, not
+        just re-run); query-scoped failures (torn spool) skip the
+        pointless task retry; everything else is task-retryable with
+        its type."""
+        if err.error_type == USER:
+            return TrinoError(str(err), err.error_code)
+        if err.connection_lost:
+            return _WorkerLost(str(err), err.error_type)
+        return _RetryableTaskError(str(err), err.error_type,
+                                   query_only=err.retry_scope == "query")
+
+    @classmethod
+    def _task_error(cls, resp: dict, task_id: str) -> Exception:
+        return cls._classify_remote(RemoteTaskError.from_response(
+            resp, f"task {task_id} failed"))
+
     def _run_output_streaming(self, frag: PlanFragment, root,
-                              locations: Dict[int, dict]) -> List[Page]:
+                              locations: Dict[int, dict],
+                              ctx: _QueryCtx) -> List[Page]:
         from ..exec.driver import Driver
         from ..exec.local_planner import (LocalExecutionPlanner,
                                           grouping_options)
@@ -513,12 +780,15 @@ class ProcessQueryRunner:
         def exchange_reader(fragment_id: int, kind: str):
             src = locations[fragment_id]
             if kind == "merge":  # per-producer streams for the merge
-                chans = [RemoteExchangeChannel([loc], 0, consumer_id=0)
-                         for loc in src["locations"]]
+                chans = [RemoteExchangeChannel(
+                    [loc], 0, consumer_id=0,
+                    rpc_timeout=ctx.rpc_timeout)
+                    for loc in src["locations"]]
                 channels.extend(chans)
                 return chans
             chan = RemoteExchangeChannel(src["locations"], 0,
-                                         consumer_id=0)
+                                         consumer_id=0,
+                                         rpc_timeout=ctx.rpc_timeout)
             channels.append(chan)
             return chan
 
@@ -535,6 +805,11 @@ class ProcessQueryRunner:
             return plan.sink.pages
         except ExchangeConnectionLost as e:
             raise _WorkerLost(f"output stage pull failed: {e}")
+        except RemoteTaskError as e:
+            # typed upstream failure: the taxonomy decides — USER fails
+            # fast, transport loss retries the query, the rest consume
+            # the retry budget
+            raise self._classify_remote(e)
         except RuntimeError as e:
             if "[connection-lost]" in str(e):
                 raise _WorkerLost(str(e))
@@ -562,7 +837,8 @@ class ProcessQueryRunner:
 
     # ----------------------------------------------- barrier mode ------
 
-    def _execute_barrier(self, qid: str, fragments, root) -> QueryResult:
+    def _execute_barrier(self, qid: str, fragments, root,
+                         ctx: _QueryCtx) -> QueryResult:
         # fragment_id -> {kind, locations: [((host, port), task_id)],
         #                 spool_dir?}
         spool_mgr = None
@@ -580,11 +856,11 @@ class ProcessQueryRunner:
                     raise _WorkerLost("no live workers")
                 if frag.output_kind == "output":
                     result_pages = self._run_output_fragment(
-                        frag, root, locations)
+                        frag, root, locations, ctx)
                 else:
                     locations[frag.fragment_id] = self._run_fragment(
-                        qid, frag, live, locations, query_tasks,
-                        spool_mgr)
+                        qid, frag, locations, query_tasks, spool_mgr,
+                        ctx)
         finally:
             # release worker buffers on success AND on failed/retried
             # attempts — abandoned attempts must not leak pages
@@ -601,84 +877,232 @@ class ProcessQueryRunner:
         return res
 
     def _run_fragment(self, qid: str, frag: PlanFragment,
-                      live: List[WorkerHandle],
                       locations: Dict[int, dict],
-                      query_tasks: List, spool_mgr=None) -> dict:
+                      query_tasks: List, spool_mgr,
+                      ctx: _QueryCtx) -> dict:
+        """One barrier stage: launch every task, retry failed attempts
+        on other workers (taxonomy-gated), speculatively re-dispatch
+        stragglers when outputs are durable, enforce the query deadline
+        while waiting."""
         ntasks = 1 if frag.partitioning == "single" else self.n_workers
         upstream = {fid: loc for fid, loc in locations.items()}
         spool_dir = None
         if spool_mgr is not None:
             spool_dir = spool_mgr.exchange_dir(qid, frag.fragment_id)
         results: List[Optional[Tuple[Tuple, str]]] = [None] * ntasks
-        errors: List[Optional[str]] = [None] * ntasks
+        #: terminal per-task failure: (message, error_type)
+        errors: List[Optional[Tuple[str, str]]] = [None] * ntasks
+        fatal: List[Exception] = []     # USER/deadline: abort the query
+        done = [threading.Event() for _ in range(ntasks)]
+        started: Dict[int, float] = {}
+        durations: Dict[int, float] = {}
+        current_attempt: Dict[int, Tuple[WorkerHandle, str]] = {}
+        reg_lock = threading.Lock()
+        closed: List[bool] = []   # set once the stage resolved
+
+        def build_req(t: int, attempt_id: str) -> dict:
+            return {
+                "op": "run_task", "task_id": attempt_id,
+                "fragment": frag, "task_index": t,
+                "task_count": ntasks,
+                "n_partitions": self.n_workers,
+                "output_kind": frag.output_kind,
+                "upstream": upstream,
+                "desired_splits": self.desired_splits,
+                "session": dict(self.session.properties),
+                "coordinator": self.service.addr,
+                "remote_write_catalogs": sorted(self._replicated),
+                "spool_dir": spool_dir,
+                "fault": self.fault_schedule.match(attempt_id),
+            }
+
+        def attempt(t: int, attempt_id: str, worker: WorkerHandle):
+            """Run one attempt to completion; first successful attempt
+            of a task registers its location (first-publish-wins at the
+            spool makes the losing duplicate harmless)."""
+            self.task_launches.append(attempt_id)
+            ctx.recovery.incr("task_attempts")
+            req = build_req(t, attempt_id)
+            try:
+                resp = worker.rpc(req, timeout=ctx.timeout())
+            except OSError:
+                worker.alive = False
+                return "lost-worker", None
+            if resp.get("ok"):
+                with reg_lock:
+                    if results[t] is None and not closed:
+                        results[t] = (worker.addr, attempt_id)
+                        query_tasks.append((worker.addr, attempt_id))
+                        durations[t] = time.monotonic() - started[t]
+                        done[t].set()
+                        return "win", None
+                # a sibling attempt won (speculation) or the stage
+                # already resolved: free this attempt's buffers
+                try:
+                    call(worker.addr, {"op": "release_task",
+                                       "task_id": attempt_id}, timeout=5)
+                except OSError:
+                    pass
+                return "superseded", None
+            return "failed", resp
 
         def run_one(t: int):
             task_id = f"{qid}.f{frag.fragment_id}.t{t}"
             tried: List[WorkerHandle] = []
-            for retry in range(self.task_retries + 1):
-                candidates = [w for w in self.workers
-                              if w.alive and w not in tried] or \
-                    [w for w in self.workers if w.alive]
-                if not candidates:
-                    errors[t] = "no live workers"
-                    return
-                worker = candidates[(t + retry) % len(candidates)]
-                tried.append(worker)
-                attempt_id = f"{task_id}.r{retry}"
-                self.task_launches.append(attempt_id)
-                req = {
-                    "op": "run_task", "task_id": attempt_id,
-                    "fragment": frag, "task_index": t,
-                    "task_count": ntasks,
-                    "n_partitions": self.n_workers,
-                    "output_kind": frag.output_kind,
-                    "upstream": upstream,
-                    "desired_splits": self.desired_splits,
-                    "session": dict(self.session.properties),
-                    "coordinator": self.service.addr,
-                    "remote_write_catalogs": sorted(self._replicated),
-                    "spool_dir": spool_dir,
-                    "inject_failure": self._take_injection(task_id),
-                }
-                try:
-                    resp = worker.rpc(req)
-                except OSError:
-                    worker.alive = False
-                    continue
-                if resp.get("ok"):
-                    results[t] = (worker.addr, attempt_id)
-                    query_tasks.append((worker.addr, attempt_id))
-                    return
-                errors[t] = resp.get("error", "unknown task error")
-            # exhausted retries
+            started[t] = time.monotonic()
+            try:
+                for retry in range(self.task_retries + 1):
+                    if done[t].is_set() or fatal:
+                        return
+                    candidates = [w for w in self.workers
+                                  if w.alive and w not in tried] or \
+                        [w for w in self.workers if w.alive]
+                    if not candidates:
+                        errors[t] = ("no live workers", EXTERNAL)
+                        return
+                    worker = candidates[(t + retry) % len(candidates)]
+                    tried.append(worker)
+                    attempt_id = f"{task_id}.r{retry}"
+                    current_attempt[t] = (worker, attempt_id)
+                    if retry > 0:
+                        _msg, etype = errors[t] or ("", EXTERNAL)
+                        ctx.recovery.record_retry(etype)
+                        self._fire_retry(attempt_id, etype, retry)
+                        self._backoff_sleep(ctx, retry - 1)
+                    # the straggler clock measures THIS attempt: failed
+                    # attempts + backoff must not make a fresh retry
+                    # look speculation-worthy the moment it launches
+                    started[t] = time.monotonic()
+                    status, resp = attempt(t, attempt_id, worker)
+                    if status in ("win", "superseded"):
+                        return
+                    if status == "lost-worker":
+                        errors[t] = (f"worker {worker.addr} lost",
+                                     EXTERNAL)
+                        continue
+                    err = self._task_error(resp, attempt_id)
+                    if isinstance(err, (TrinoError, _WorkerLost)) or \
+                            getattr(err, "query_only", False):
+                        # USER: abort now; worker-lost / query-scoped
+                        # (torn spool): another worker hits the same
+                        # wall — only heal + query retry can recover
+                        fatal.append(err)
+                        return
+                    errors[t] = (str(err), err.error_type)
+                # exhausted retries
+            except TrinoError as e:   # deadline expired mid-attempt
+                fatal.append(e)
+            except BaseException as e:
+                errors[t] = (repr(e), INTERNAL)
+            finally:
+                done[t].set()
 
-        threads = [threading.Thread(target=run_one, args=(t,))
+        threads = [threading.Thread(target=run_one, args=(t,),
+                                    daemon=True)
                    for t in range(ntasks)]
         for th in threads:
             th.start()
-        for th in threads:
-            th.join()
+        self._supervise(ntasks, done, durations, started,
+                        current_attempt, fatal, qid, frag, spool_dir,
+                        attempt, ctx)
+        with reg_lock:
+            closed.append(True)
+        if fatal:
+            raise fatal[0]
         for t in range(ntasks):
             if results[t] is None:
-                if errors[t] and "no live workers" not in errors[t] \
+                msg, etype = errors[t] or ("task lost", EXTERNAL)
+                if "no live workers" not in msg \
                         and all(w.alive for w in self.workers):
-                    raise TrinoError(
+                    raise _RetryableTaskError(
                         f"task {t} of fragment {frag.fragment_id} "
-                        f"failed: {errors[t]}", "GENERIC_INTERNAL_ERROR")
-                raise _WorkerLost(errors[t] or "task lost")
+                        f"failed: {msg}", etype)
+                raise _WorkerLost(msg, etype)
         loc = {"kind": frag.output_kind,
                "locations": [results[t] for t in range(ntasks)]}
         if spool_dir is not None:
             loc["spool_dir"] = spool_dir
         return loc
 
+    def _supervise(self, ntasks, done, durations, started,
+                   current_attempt, fatal, qid, frag, spool_dir,
+                   attempt, ctx: _QueryCtx):
+        """Wait for the stage while (a) enforcing the query deadline and
+        (b) speculatively re-dispatching stragglers: when a task has run
+        far past the median of its completed siblings and outputs are
+        durable (spool), a second attempt launches on another worker —
+        first publish wins (reference: the faulttolerant scheduler's
+        speculative task execution)."""
+        speculated = set()
+        speculate = (spool_dir is not None and ctx.spec_enabled
+                     and ntasks > 1)
+
+        def spec_run(t: int, worker: WorkerHandle):
+            attempt_id = f"{qid}.f{frag.fragment_id}.t{t}.spec"
+            try:
+                status, _resp = attempt(t, attempt_id, worker)
+            except BaseException:
+                return  # a failed speculation never hurts the original
+            if status == "win":
+                ctx.recovery.incr("speculative_wins")
+                # the straggling original is now pointless: abort it so
+                # it cannot publish into a torn-down query
+                orig = current_attempt.get(t)
+                if orig is not None:
+                    try:
+                        call(orig[0].addr, {"op": "abort_task",
+                                            "task_id": orig[1]},
+                             timeout=5)
+                    except OSError:
+                        pass
+
+        while not all(ev.is_set() for ev in done):
+            try:
+                ctx.deadline.check()
+            except TrinoError as e:
+                fatal.append(e)
+                # unblock run_one threads waiting on nothing; attempts
+                # in flight resolve as superseded once `closed` is set
+                for ev in done:
+                    ev.set()
+                return
+            if speculate and len(durations) >= max(1, ntasks // 2):
+                median = statistics.median(durations.values())
+                threshold = max(ctx.spec_min_s,
+                                ctx.spec_multiplier * median)
+                now = time.monotonic()
+                for t in range(ntasks):
+                    if done[t].is_set() or t in speculated \
+                            or t not in started \
+                            or now - started[t] <= threshold:
+                        continue
+                    straggler = current_attempt.get(t)
+                    others = [w for w in self.workers if w.alive and
+                              (straggler is None or w is not straggler[0])]
+                    if not others:
+                        continue
+                    speculated.add(t)
+                    ctx.recovery.incr("speculative_launched")
+                    self._fire_retry(
+                        f"{qid}.f{frag.fragment_id}.t{t}.spec",
+                        EXTERNAL, 0, speculative=True)
+                    threading.Thread(
+                        target=spec_run, args=(t, others[t % len(others)]),
+                        daemon=True).start()
+            time.sleep(0.02)
+
     def _run_output_fragment(self, frag: PlanFragment, root,
-                             locations: Dict[int, dict]) -> List[Page]:
+                             locations: Dict[int, dict],
+                             ctx: _QueryCtx) -> List[Page]:
         """The root (single) fragment runs in the coordinator, pulling
         from workers — the reference's coordinator-only output stage."""
         from ..exec.local_planner import (LocalExecutionPlanner,
                                           grouping_options)
         from ..planner.plan import OutputNode
+        from .spool import SpoolCorruption
+
+        def on_retry(exc):
+            ctx.recovery.record_retry(EXTERNAL)
 
         def exchange_reader(fragment_id: int, kind: str):
             src = locations[fragment_id]
@@ -693,8 +1117,9 @@ class ProcessQueryRunner:
 
                 def task_thunk(loc):
                     def thunk():
-                        de = PageDeserializer()
-                        return fetch_pages(tuple(loc[0]), loc[1], 0, de)
+                        return fetch_pages(tuple(loc[0]), loc[1], 0,
+                                           timeout=ctx.timeout(),
+                                           on_retry=on_retry)
 
                     return thunk
 
@@ -707,9 +1132,9 @@ class ProcessQueryRunner:
             def thunk():
                 pages: List[Page] = []
                 for addr, up_task in src["locations"]:
-                    de = PageDeserializer()
                     pages.extend(fetch_pages(tuple(addr), up_task, part,
-                                             de))
+                                             timeout=ctx.timeout(),
+                                             on_retry=on_retry))
                 return pages
 
             return thunk
@@ -722,7 +1147,18 @@ class ProcessQueryRunner:
             plan = planner.plan(OutputNode(frag.root, root.column_names,
                                            root.outputs))
             return plan.execute()
-        except (OSError, RuntimeError) as e:
+        except RemoteTaskError as e:
+            # the taxonomy decides (round-6 satellite: a deterministic
+            # execution error must NOT masquerade as a lost worker and
+            # trigger a pointless full-query retry)
+            raise self._classify_remote(e)
+        except SpoolCorruption as e:
+            # a task retry would re-read the same torn bytes; only a
+            # fresh query attempt (new spool) can recover
+            raise _RetryableTaskError(str(e), EXTERNAL, query_only=True)
+        except OSError as e:
+            # transport-only: the producing worker or its buffers are
+            # gone (FileNotFoundError covers an unpublished spool)
             raise _WorkerLost(f"output stage pull failed: {e}")
 
     def _release(self, query_tasks):
@@ -742,8 +1178,20 @@ class _WorkerLost(Exception):
     (reference: RetryPolicy.QUERY — stage outputs were lost, task-level
     retry cannot recover them)."""
 
+    def __init__(self, message: str, error_type: str = EXTERNAL):
+        super().__init__(message)
+        self.error_type = error_type
+
 
 class _RetryableTaskError(Exception):
-    """A task failed under streaming execution, where outputs are not
-    durable and task-level retry cannot replay them: retry the query
-    once (the spooled exchange upgrades this to retry-from-spool)."""
+    """A task failed with a retryable (non-USER) error where task-level
+    retry cannot replay it in place: re-run the query under the attempt
+    budget (the spooled exchange upgrades this to retry-from-spool).
+    ``query_only`` marks failures a task retry can NEVER fix (torn
+    spool: another worker re-reads the same bytes)."""
+
+    def __init__(self, message: str, error_type: str = INTERNAL,
+                 query_only: bool = False):
+        super().__init__(message)
+        self.error_type = error_type
+        self.query_only = query_only

@@ -53,11 +53,12 @@ class RemoteExchangeChannel:
 
     def __init__(self, locations: List[Tuple[tuple, str]], partition: int,
                  consumer_id: int = 0, max_local: int = 16,
-                 poll_wait: float = 0.5):
+                 poll_wait: float = 0.5, rpc_timeout: float = 60.0):
         self.partition = partition
         self.consumer_id = consumer_id
         self.max_local = max_local
         self.poll_wait = poll_wait
+        self.rpc_timeout = rpc_timeout
         self._lock = threading.Lock()
         self._queue: List = []
         self._version = 0
@@ -94,8 +95,8 @@ class RemoteExchangeChannel:
                     if self._stop:
                         return
                     try:
-                        with socket.create_connection(addr,
-                                                      timeout=60) as sock:
+                        with socket.create_connection(
+                                addr, timeout=self.rpc_timeout) as sock:
                             send_msg(sock, {
                                 "op": "get_page_stream",
                                 "task_id": task_id,
@@ -113,8 +114,13 @@ class RemoteExchangeChannel:
                         if head.get("connection_lost") or \
                                 "[connection-lost]" in msg:
                             raise ExchangeConnectionLost(msg)
-                        raise RuntimeError(
-                            f"upstream task {task_id} failed: {msg}")
+                        from .fault import RemoteTaskError
+
+                        # typed upstream failure: carry the error type +
+                        # remote traceback so the coordinator fails fast
+                        # on USER errors instead of retrying the query
+                        raise RemoteTaskError.from_response(
+                            head, f"upstream task {task_id} failed")
                     if frames:
                         de = self._des[task_id]
                         pages = [de.deserialize(f) for f in frames]

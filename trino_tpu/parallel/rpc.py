@@ -56,16 +56,57 @@ def call(addr, request: dict, timeout: float = 600.0) -> dict:
 
 
 def fetch_pages(addr, task_id: str, partition: int,
-                deserializer, timeout: float = 600.0):
-    """Pull one task's partition: returns a list of Pages."""
-    with socket.create_connection(addr, timeout=timeout) as sock:
-        send_msg(sock, {"op": "get_results", "task_id": task_id,
-                        "partition": partition})
-        head = recv_msg(sock)
-        if head.get("error"):
-            raise RuntimeError(f"worker get_results failed: "
-                               f"{head['error']}")
-        pages = []
-        for _ in range(head["n_pages"]):
-            pages.append(deserializer.deserialize(recv_frame(sock)))
-        return pages
+                deserializer=None, timeout: float = 600.0,
+                retries: int = 2, retry_backoff: float = 0.05,
+                on_retry=None):
+    """Pull one task's partition snapshot: returns a list of Pages.
+
+    Failure semantics (the FT seam):
+    - a worker-side failure propagates as RemoteTaskError carrying the
+      remote error TYPE and traceback, so the coordinator can decide
+      fail-fast (USER) vs retry (everything else) — not a bare string;
+    - a connection dropped mid-frame is retried here with backoff: each
+      ``get_results`` response is a complete, independently-serialized
+      snapshot (the worker keeps the buffer and builds a fresh serde
+      stream per request), so a re-pull cannot lose or duplicate pages.
+      Streaming pulls (``get_page_stream``) must NOT reconnect — their
+      drain cursor advances server-side — and use their own channel.
+    """
+    import time
+
+    from .fault import EXTERNAL, RemoteTaskError
+
+    last: Exception = None
+    for attempt in range(retries + 1):
+        try:
+            with socket.create_connection(addr, timeout=timeout) as sock:
+                send_msg(sock, {"op": "get_results", "task_id": task_id,
+                                "partition": partition})
+                head = recv_msg(sock)
+                if head.get("error"):
+                    raise RemoteTaskError.from_response(
+                        head, f"worker get_results({task_id}) failed")
+                de = deserializer if deserializer is not None \
+                    and attempt == 0 else _fresh_deserializer()
+                pages = []
+                for _ in range(head["n_pages"]):
+                    pages.append(de.deserialize(recv_frame(sock)))
+                return pages
+        except RemoteTaskError:
+            raise  # typed worker failure: the taxonomy decides upstream
+        except OSError as e:  # includes ConnectionError mid-frame
+            last = e
+            if attempt < retries:
+                if on_retry is not None:
+                    on_retry(e)
+                time.sleep(retry_backoff * (2 ** attempt))
+    raise RemoteTaskError(
+        f"pull from {addr} task {task_id} failed after "
+        f"{retries + 1} attempts: {last!r}", EXTERNAL,
+        "PAGE_TRANSPORT_ERROR", connection_lost=True)
+
+
+def _fresh_deserializer():
+    from ..exec.serde import PageDeserializer
+
+    return PageDeserializer()

@@ -49,14 +49,23 @@ class ExchangeSink:
         f.write(frame)
 
     def finish(self):
-        """Publish atomically: fsync then rename into the final name —
-        a half-written spool must never be readable under it."""
+        """Publish atomically, first-publish-wins: fsync, then link the
+        temp file under the final name — a half-written spool must never
+        be readable, and when two attempts of the same task race (a
+        speculative re-dispatch plus its straggling original), the first
+        published output stays and the duplicate is discarded, so
+        consumers can never observe a file swap mid-read."""
         for p, f in enumerate(self._tmp):
             f.flush()
             os.fsync(f.fileno())
             f.close()
-            os.rename(f.name, os.path.join(
-                self.directory, f"p{p}.t{self.task}.bin"))
+            target = os.path.join(self.directory,
+                                  f"p{p}.t{self.task}.bin")
+            try:
+                os.link(f.name, target)  # atomic, fails if published
+            except FileExistsError:
+                pass  # a sibling attempt won the publish race
+            os.unlink(f.name)
 
     def abort(self):
         for f in self._tmp:
@@ -78,9 +87,26 @@ def _read_task_file(path: str) -> List:
             head = f.read(4)
             if not head:
                 break
+            if len(head) < 4:
+                raise SpoolCorruption(f"torn frame header in {path}")
             (n,) = struct.unpack("<I", head)
-            pages.append(de.deserialize(f.read(n)))
+            blob = f.read(n)
+            if len(blob) < n:
+                # a published file must hold complete frames; a short
+                # read means on-disk corruption (e.g. torn by a crashed
+                # host) — losing rows silently is never acceptable
+                raise SpoolCorruption(
+                    f"torn frame in {path}: expected {n} bytes, "
+                    f"read {len(blob)}")
+            pages.append(de.deserialize(blob))
     return pages
+
+
+class SpoolCorruption(RuntimeError):
+    """A published spool file is torn/corrupt. Classified EXTERNAL (the
+    durable store failed the engine): retryable, but a task retry will
+    re-read the same bytes — recovery needs the QUERY-level retry that
+    rebuilds the exchange under a fresh attempt id."""
 
 
 def read_spool_task(directory: str, partition: int, task: int) -> List:

@@ -25,8 +25,10 @@ Protocol (rpc.py framing; one request per connection):
   run_task        {task_id, fragment, task_index, task_count,
                    output_kind, n_partitions, upstream, session,
                    streaming?, buffer_bound?, coordinator?,
-                   remote_write_catalogs?, inject_failure?}
-                                                    -> {ok|error, rows?}
+                   remote_write_catalogs?, fault? (FaultSchedule
+                   directive; legacy inject_failure => kind=error)}
+                          -> {ok, rows?} | {error, error_type,
+                              error_code, remote_traceback}
   get_results     {task_id, partition}              -> header + frames
   get_page_stream {task_id, partition, consumer_id, wait}
                                                     -> header + frames
@@ -47,7 +49,7 @@ import sys
 import threading
 import time
 import traceback
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from .rpc import recv_msg, send_frame, send_msg
 
@@ -56,12 +58,16 @@ class _TaskState:
     def __init__(self):
         self.status = "running"
         self.error = None
+        self.failure = None         # fault.serialize_failure dict
         self.buffer = None          # ops.output.OutputBuffer
         self.rows = 0
         self.abort = threading.Event()
         self.serializers: Dict[tuple, object] = {}
         self.channels: List = []    # RemoteExchangeChannels to close
         self.thread = None
+        #: armed drop-connection occurrences: result pulls for this task
+        #: close mid-frame this many times (FaultSchedule directive)
+        self.drop_results = 0
 
 
 class WorkerServer:
@@ -162,6 +168,7 @@ class WorkerServer:
             else:
                 out[tid] = {
                     "status": state.status, "error": state.error,
+                    "error_type": (state.failure or {}).get("error_type"),
                     "rows": state.rows,
                     "overlapped": (state.buffer.overlapped
                                    if state.buffer is not None and
@@ -205,24 +212,29 @@ class WorkerServer:
 
     def run_task(self, req: dict) -> dict:
         from ..ops.output import OutputBuffer
+        from .fault import serialize_failure
 
         task_id = req["task_id"]
         state = _TaskState()
+        fault = self._task_fault(req)
+        if fault.get("kind") == "drop-connection":
+            # fires at the result-serving seam, not task execution
+            state.drop_results = 1
         with self._lock:
             self.tasks[task_id] = state
         if not req.get("streaming"):
             try:
-                if req.get("inject_failure"):
-                    raise RuntimeError(
-                        f"injected failure for task {task_id}")
-                state.rows = self._execute_fragment(req, state)
+                self._apply_start_fault(fault, task_id)
+                state.rows = self._execute_fragment(req, state,
+                                                    fault=fault)
                 state.status = "finished"
                 return {"ok": True, "rows": state.rows}
             except Exception as e:
                 state.status = "failed"
-                state.error = repr(e)
+                state.failure = serialize_failure(e)
+                state.error = state.failure["error"]
                 traceback.print_exc()
-                return {"error": state.error, "task_id": task_id}
+                return dict(state.failure, task_id=task_id)
         # streaming: the buffer must exist before we acknowledge, so
         # consumers can start pulling immediately
         frag = req["fragment"]
@@ -232,29 +244,65 @@ class WorkerServer:
             broadcast=frag.output_kind == "broadcast",
             max_pending_pages=req.get("buffer_bound"))
         state.thread = threading.Thread(
-            target=self._run_streaming, args=(req, state), daemon=True)
+            target=self._run_streaming, args=(req, state, fault),
+            daemon=True)
         state.thread.start()
         return {"ok": True, "started": True}
 
-    def _run_streaming(self, req: dict, state: _TaskState):
+    @staticmethod
+    def _task_fault(req: dict) -> dict:
+        """The coordinator's fault directive for this launch; the
+        legacy one-shot ``inject_failure`` flag maps to kind=error."""
+        fault = req.get("fault") or {}
+        if not fault and req.get("inject_failure"):
+            fault = {"kind": "error"}
+        return fault
+
+    @staticmethod
+    def _apply_start_fault(fault: dict, task_id: str):
+        """Faults that fire at task start (reference:
+        FailureInjector.injectTaskFailure with an error type)."""
+        kind = fault.get("kind")
+        if not kind:
+            return
+        if kind == "error":
+            raise RuntimeError(f"injected failure for task {task_id}")
+        if kind == "user-error":
+            from ..types import TrinoError
+
+            raise TrinoError(
+                f"injected user error for task {task_id}",
+                fault.get("error_code", "DIVISION_BY_ZERO"))
+        if kind == "kill-worker":
+            # the process dies mid-RPC: the coordinator observes a
+            # connection drop, exactly like a crashed/OOM-killed worker
+            sys.stderr.write(f"worker: injected kill for {task_id}\n")
+            sys.stderr.flush()
+            os._exit(137)
+        if kind == "delay":
+            time.sleep(float(fault.get("delay_s", 1.0)))
+
+    def _run_streaming(self, req: dict, state: _TaskState, fault: dict):
+        from .fault import serialize_failure
         from .remote_exchange import ExchangeConnectionLost
 
         try:
-            if req.get("inject_failure"):
-                # reference: execution/FailureInjector.java:40 — typed
-                # error injected at task execution for FT tests
-                raise RuntimeError(
-                    f"injected failure for task {req['task_id']}")
+            self._apply_start_fault(fault, req["task_id"])
             state.rows = self._execute_fragment(req, state,
-                                                streaming=True)
+                                                streaming=True,
+                                                fault=fault)
             state.status = "finished"
             state.buffer.set_no_more_pages()
         except ExchangeConnectionLost as e:
             state.error = f"[connection-lost] {e!r}"
+            state.failure = serialize_failure(e)
+            state.failure["error"] = state.error
+            state.failure["connection_lost"] = True
             state.status = "failed"
             state.buffer.abort()
         except Exception as e:
-            state.error = repr(e)
+            state.failure = serialize_failure(e)
+            state.error = state.failure["error"]
             state.status = "failed"
             if not state.abort.is_set():
                 traceback.print_exc()
@@ -300,7 +348,8 @@ class WorkerServer:
         return factory
 
     def _execute_fragment(self, req: dict, state: _TaskState,
-                          streaming: bool = False) -> int:
+                          streaming: bool = False,
+                          fault: Optional[dict] = None) -> int:
         from ..exec.driver import Driver
         from ..exec.local_planner import (LocalExecutionPlanner,
                                           grouping_options,
@@ -316,6 +365,8 @@ class WorkerServer:
         frag = req["fragment"]
         upstream: Dict[int, dict] = req["upstream"]
         task_index = req["task_index"]
+        rpc_timeout = float(req.get("session", {}).get(
+            "rpc_request_timeout", 600.0))
 
         def exchange_reader(fragment_id: int, kind: str):
             src = upstream[fragment_id]
@@ -332,15 +383,16 @@ class WorkerServer:
                         for i in range(len(src["locations"]))]
                 if streaming:
                     chans = [RemoteExchangeChannel([loc], 0,
-                                                   consumer_id=task_index)
+                                                   consumer_id=task_index,
+                                                   rpc_timeout=rpc_timeout)
                              for loc in src["locations"]]
                     state.channels.extend(chans)
                     return chans
 
                 def task_thunk(loc):
                     def thunk():
-                        de = PageDeserializer()
-                        return fetch_pages(tuple(loc[0]), loc[1], 0, de)
+                        return fetch_pages(tuple(loc[0]), loc[1], 0,
+                                           timeout=rpc_timeout)
 
                     return thunk
 
@@ -355,16 +407,16 @@ class WorkerServer:
                 return lambda: read_spool(src["spool_dir"], part)
             if streaming:
                 chan = RemoteExchangeChannel(
-                    src["locations"], part, consumer_id=task_index)
+                    src["locations"], part, consumer_id=task_index,
+                    rpc_timeout=rpc_timeout)
                 state.channels.append(chan)
                 return chan
 
             def thunk():
                 pages: List = []
                 for addr, up_task in src["locations"]:
-                    de = PageDeserializer()
                     pages.extend(fetch_pages(tuple(addr), up_task, part,
-                                             de))
+                                             timeout=rpc_timeout))
                 return pages
 
             return thunk
@@ -407,6 +459,11 @@ class WorkerServer:
             # this process dies right after responding
             from .spool import ExchangeSink
 
+            if state.abort.is_set():
+                # a sibling attempt already won (speculative execution):
+                # publishing now would race the query teardown
+                raise RuntimeError(f"task {req['task_id']} aborted "
+                                   "before spool publish")
             nparts = 1 if frag.output_kind in ("single", "broadcast",
                                                "merge") \
                 else req["n_partitions"]
@@ -419,24 +476,78 @@ class WorkerServer:
             except BaseException:
                 sink.abort()
                 raise
+            self._apply_post_publish_fault(fault or {}, req, spool_dir,
+                                           task_index, nparts)
         return buffer.total_rows
+
+    @staticmethod
+    def _apply_post_publish_fault(fault: dict, req: dict,
+                                  spool_dir: str, task_index: int,
+                                  nparts: int):
+        """Faults that fire AFTER the durable publish: the retry path
+        must observe first-publish-wins (fail-after-publish) and detect
+        torn files (truncate-spool)."""
+        kind = fault.get("kind")
+        if kind == "fail-after-publish":
+            raise RuntimeError(
+                f"injected failure after spool publish for task "
+                f"{req['task_id']}")
+        if kind == "truncate-spool":
+            # tear the last published partition file mid-frame: readers
+            # must fail loudly (short read), never return partial rows
+            for part in reversed(range(nparts)):
+                path = os.path.join(spool_dir,
+                                    f"p{part}.t{task_index}.bin")
+                size = os.path.getsize(path)
+                if size > 3:
+                    with open(path, "r+b") as f:
+                        f.truncate(size - 3)
+                    break
 
     # ------------------------------------------------------------------
 
     def send_results(self, sock, task_id: str, partition: int):
         from ..exec.serde import PageSerializer
+        from .fault import EXTERNAL
 
         with self._lock:
             state = self.tasks.get(task_id)
         if state is None or state.status != "finished":
-            send_msg(sock, {"error": f"task {task_id} not finished "
-                            f"({'missing' if state is None else state.status})"})
+            resp = {"error": f"task {task_id} not finished "
+                    f"({'missing' if state is None else state.status})"}
+            if state is None:
+                # buffers gone (released/expired): transport-class loss
+                resp.update(error_type=EXTERNAL, connection_lost=True)
+            elif state.failure is not None:
+                # surface the REAL task failure (type + remote stack),
+                # not a flattened "not finished" string
+                resp = dict(state.failure)
+            send_msg(sock, resp)
             return
         pages = state.buffer.pages(partition)
-        send_msg(sock, {"n_pages": len(pages)})
         ser = PageSerializer()
-        for p in pages:
-            send_frame(sock, ser.serialize(p))
+        frames = [ser.serialize(p) for p in pages]
+        if state.drop_results > 0:
+            state.drop_results -= 1
+            self._send_torn_frame(sock, {"n_pages": len(frames)}, frames)
+            return
+        send_msg(sock, {"n_pages": len(frames)})
+        for f in frames:
+            send_frame(sock, f)
+
+    @staticmethod
+    def _send_torn_frame(sock, head: dict, frames: List[bytes]):
+        """Injected drop-RPC-connection-mid-frame (one seam for both
+        pull paths): claim the full response, ship half of the first
+        frame, close. The consumer sees "peer closed mid-frame" exactly
+        as with a worker crash between frames."""
+        import struct as _struct
+
+        send_msg(sock, head)
+        blob = frames[0] if frames else b"\0" * 64
+        sock.sendall(_struct.pack("<I", len(blob)) +
+                     blob[:max(1, len(blob) // 2)])
+        sock.close()
 
     def stream_results(self, sock, req: dict):
         """Incremental long-poll pull of one consumer's partition
@@ -471,15 +582,25 @@ class WorkerServer:
             # guaranteed to see status=="failed" here — a done=True
             # reply must never paper over a failure as clean EOS
             if state.status == "failed":
-                send_msg(sock, {
-                    "error": state.error or "task failed",
-                    "connection_lost": "[connection-lost]"
-                    in (state.error or "")})
+                resp = dict(state.failure) if state.failure else {}
+                resp.setdefault("error", state.error or "task failed")
+                resp.setdefault("connection_lost", "[connection-lost]"
+                                in (state.error or ""))
+                send_msg(sock, resp)
                 return
             if frames or done or time.monotonic() >= deadline:
                 break
             wait_readable(buf, timeout=min(
                 0.25, max(0.0, deadline - time.monotonic())))
+        if state.drop_results > 0 and frames:
+            # injected mid-frame drop on the streaming pull: the drain
+            # cursor already advanced, so the pages are unrecoverable —
+            # the consumer must classify this as connection-lost and the
+            # query must retry (streaming outputs are not durable)
+            state.drop_results -= 1
+            self._send_torn_frame(sock, {"n_pages": len(frames),
+                                         "done": done}, frames)
+            return
         send_msg(sock, {"n_pages": len(frames), "done": done})
         for f in frames:
             send_frame(sock, f)

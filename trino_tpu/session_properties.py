@@ -91,6 +91,48 @@ register(SessionProperty(
     "tasks retry from spool WITHOUT re-running producer stages)",
     lambda v: v in ("NONE", "QUERY", "TASK")))
 register(SessionProperty(
+    "rpc_request_timeout", "double", 600.0,
+    "Seconds a single coordinator<->worker RPC may take before the "
+    "request is abandoned (reference: query.remote-task.max-error "
+    "duration); replaces the old hardwired 600 s",
+    lambda v: v > 0))
+register(SessionProperty(
+    "query_max_run_time", "double", 0.0,
+    "Wall-clock deadline for one query in seconds, enforced across all "
+    "coordinator->worker RPCs and retry backoff waits; exceeding it "
+    "raises EXCEEDED_TIME_LIMIT (a USER error: never retried). "
+    "0 = unlimited",
+    lambda v: v >= 0))
+register(SessionProperty(
+    "retry_max_attempts", "integer", 4,
+    "Per-query attempt budget for retryable failures (worker loss, "
+    "transport faults, internal errors); USER errors never consume it",
+    lambda v: v >= 1))
+register(SessionProperty(
+    "retry_initial_backoff", "double", 0.05,
+    "First retry delay in seconds; doubles per attempt with "
+    "deterministic jitter up to retry_max_backoff",
+    lambda v: v > 0))
+register(SessionProperty(
+    "retry_max_backoff", "double", 2.0,
+    "Upper bound on the exponential retry backoff in seconds",
+    lambda v: v > 0))
+register(SessionProperty(
+    "speculative_execution_enabled", "boolean", True,
+    "Under retry_policy=TASK, re-dispatch a straggling task on another "
+    "worker once it runs far past the median of its completed siblings; "
+    "the spool's first-publish-wins rename makes duplicates safe"))
+register(SessionProperty(
+    "speculation_multiplier", "double", 2.0,
+    "A task is a straggler when its runtime exceeds this multiple of "
+    "the median runtime of its fragment's completed sibling tasks",
+    lambda v: v >= 1))
+register(SessionProperty(
+    "speculation_min_seconds", "double", 1.0,
+    "Never speculate before a task has run at least this long "
+    "(guards against re-dispatching short tasks on scheduling noise)",
+    lambda v: v >= 0))
+register(SessionProperty(
     "hash_grouping_enabled", "boolean", True,
     "GROUP BY via the vectorized open-addressing hash table "
     "(ops/hashtable.py): dense group ids without sorting key and state "
