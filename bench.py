@@ -37,7 +37,10 @@ is preceded by a ``*_stage_wall_ms`` line carrying the per-stage
 (scan/filter-project/agg/join/exchange/sort) wall-time breakdown of the
 final repeat and the query's per-kernel jit-trace deltas (all repeats
 of that query; the first pays them). Internal: BENCH_ROLE=measure
-BENCH_PLATFORM=cpu|default.
+BENCH_PLATFORM=cpu|default; BENCH_ROLE=chaos (fault-injection smoke,
+CHAOS_RESULT line); BENCH_ROLE=memory (memory-governance smoke:
+forced host+disk spill oracle + killer determinism, MEMORY_RESULT
+line with spill/kill counters, rc=5 on mismatch).
 """
 
 import json
@@ -200,6 +203,59 @@ def _chaos_smoke(n_workers: int = 2, seed: int = 7) -> dict:
     print("CHAOS_RESULT " + json.dumps(out), flush=True)
     if not out["ok"]:
         raise SystemExit(4)
+    return out
+
+
+def _memory_smoke() -> dict:
+    """BENCH_ROLE=memory: memory-governance smoke — run the q18-shaped
+    aggregation under a cap that forces host-RAM AND disk spill, assert
+    the rows byte-equal the unconstrained run, and emit the spill/kill
+    counters as a MEMORY_RESULT line so governance regressions show up
+    in BENCH_*.json. rc=5 on mismatch."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.parallel.cluster_memory import ClusterMemoryManager
+    from trino_tpu.runner import LocalQueryRunner
+    from trino_tpu.sql.analyzer import Session
+
+    sql = ("select l_orderkey, sum(l_quantity) qty from lineitem "
+           "group by l_orderkey order by qty desc, l_orderkey limit 10")
+
+    def run(**props):
+        s = Session(catalog="tpch", schema="micro")
+        s.properties.update(props)
+        return LocalQueryRunner(
+            {"tpch": TpchConnector(page_rows=1024)}, s,
+            desired_splits=8).execute(sql)
+
+    t0 = time.time()
+    clean = run()
+    spilled = run(query_max_memory_bytes=600_000, spill_enabled=True,
+                  spill_to_disk_enabled=True, spill_host_memory_bytes=0)
+    mem = spilled.stats["memory"]
+    # killer determinism rides along: a synthetic blocked-node snapshot
+    # must always name the same victim
+    mgr = ClusterMemoryManager("total-reservation-on-blocked-nodes")
+    mgr.update(0, {"max_bytes": 100, "reserved_bytes": 100,
+                   "blocked_events": 1,
+                   "queries": {"qa": {"reserved": 70, "peak": 70},
+                               "qb": {"reserved": 30, "peak": 30}}})
+    victim = mgr.maybe_kill()
+    out = {
+        "ok": spilled.rows == clean.rows and victim == "qa",
+        "spill_events": mem.get("spill_events", 0),
+        "spilled_bytes": mem.get("spilled_bytes", 0),
+        "disk_spill_events": mem.get("disk_spill_events", 0),
+        "disk_spilled_bytes": mem.get("disk_spilled_bytes", 0),
+        "killer_victim": victim,
+        "wall_s": round(time.time() - t0, 2),
+    }
+    print("MEMORY_RESULT " + json.dumps(out), flush=True)
+    if not out["ok"]:
+        raise SystemExit(5)
     return out
 
 
@@ -417,5 +473,7 @@ if __name__ == "__main__":
         _measure_child()
     elif os.environ.get("BENCH_ROLE") == "chaos":
         _chaos_smoke()
+    elif os.environ.get("BENCH_ROLE") == "memory":
+        _memory_smoke()
     else:
         main()

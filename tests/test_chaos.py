@@ -18,6 +18,7 @@ All cases run 2 workers on the micro schema to stay far under the ~10 s
 per-case tier-1 budget rule.
 """
 
+import threading
 import time
 
 import pytest
@@ -398,3 +399,118 @@ def test_user_error_fails_fast_streaming(stream_cluster):
     assert "injected user error" in str(ei.value)
     launches = _launches_since(c, mark)
     assert not any("a1." in t for t in launches), launches
+
+
+# ---------------------------------------------------- memory governance ----
+
+
+def test_memory_escalation_retry(barrier_cluster):
+    """THE memory-governance acceptance path: an attempt that dies with
+    INSUFFICIENT_RESOURCES (per-query cap far below the working set)
+    re-admits with a GROWN budget — max(retry_initial_memory, 2x the
+    observed peak the worker piggybacked on its failure response) — and
+    a halved task width, instead of replaying the identical doomed
+    plan."""
+    c = barrier_cluster
+    _await_capacity(c)
+    clean = sorted(c.execute(Q1).rows)
+    saved = dict(c.session.properties)
+    c.session.properties.update({"query_max_memory_bytes": 60_000,
+                                 "retry_initial_memory": 1 << 30})
+    mark = len(c.task_launches)
+    try:
+        res = c.execute(Q1)
+    finally:
+        c.session.properties.clear()
+        c.session.properties.update(saved)
+    assert sorted(res.rows) == clean
+    rec = res.stats["recovery"]
+    assert rec["memory_escalations"] >= 1
+    assert rec["retries_by_type"].get("INSUFFICIENT_RESOURCES", 0) >= 1
+    launches = _launches_since(c, mark)
+    # width reduction: the escalated attempt (a1) runs its partitioned
+    # fragments at half width -> no .t1 tasks
+    a1 = [t for t in launches if "a1." in t]
+    assert a1, launches
+    assert not any(".t1" in t for t in a1), a1
+    # the configured session must come back untouched (overrides are
+    # per-attempt state, not global mutation)
+    assert c.session.properties == saved
+
+
+def test_low_memory_killer_kills_policy_victim(barrier_cluster):
+    """Cluster-overcommit: with a blocked node attributing the largest
+    reservation to the in-flight query, the governance tick kills
+    exactly the policy-chosen victim (EXCEEDED_CLUSTER_MEMORY); the
+    victim then SUCCEEDS on retry while a concurrent query finishes
+    unharmed."""
+    from trino_tpu.events import EventListener
+
+    class KillRecorder(EventListener):
+        def __init__(self):
+            self.kills = []
+
+        def memory_kill(self, event):
+            self.kills.append(event)
+
+    c = barrier_cluster
+    _await_capacity(c)
+    rec = KillRecorder()
+    c.event_manager.add(rec)
+    clean = sorted(c.execute(Q1).rows)
+    victim_qid = _next_qid(c)
+    # slow the victim's scan tasks so the kill window is open
+    c.fault_schedule.add(f"{victim_qid}.f1", "delay", times=2,
+                         delay_s=1.5)
+    results = {}
+
+    def run_victim():
+        results["victim"] = sorted(c.execute(Q1).rows)
+
+    th = threading.Thread(target=run_victim, daemon=True)
+    th.start()
+    time.sleep(0.4)  # victim tasks are now sleeping in their delay
+    # a blocked node reports the victim attempt as its largest holder
+    # (synthetic worker id: real heartbeats never overwrite it)
+    c.cluster_memory.update(99, {
+        "max_bytes": 1000, "reserved_bytes": 1000, "blocked_events": 1,
+        "queries": {victim_qid: {"reserved": 900, "peak": 900},
+                    "tiny_q": {"reserved": 100, "peak": 100}}})
+    assert c.run_memory_governance() == victim_qid
+    # a concurrent query sails through while the victim is dying
+    assert sorted(c.execute(Q1).rows) == clean
+    th.join(timeout=60)
+    assert not th.is_alive()
+    c.cluster_memory.forget_worker(99)
+    assert results["victim"] == clean
+    assert [e.query_id for e in rec.kills] == [victim_qid]
+    assert rec.kills[0].policy == "total-reservation-on-blocked-nodes"
+
+
+def test_heartbeat_piggybacks_pool_snapshots(barrier_cluster):
+    """Stats parity: what the ClusterMemoryManager aggregated from the
+    heartbeat must equal what the workers report when asked directly."""
+    from trino_tpu.parallel.rpc import call
+
+    c = barrier_cluster
+    _await_capacity(c)
+    c.execute(Q1)
+    c.heartbeat()
+    stats = c.cluster_memory.cluster_stats()
+    direct = []
+    for w in c.workers:
+        resp = call(w.addr, {"op": "ping"}, timeout=10)
+        assert resp.get("memory") is not None
+        direct.append(resp["memory"])
+    assert stats["workers"] == len(c.workers)
+    assert stats["total_max_bytes"] == sum(m["max_bytes"]
+                                           for m in direct)
+    # per-query peaks flowed through: the finished query left its peak
+    # in some worker's released-peaks section
+    peaks = [q["peak"] for m in direct
+             for q in m.get("queries", {}).values()]
+    assert any(p > 0 for p in peaks)
+    # EXPLAIN ANALYZE surfaces the cluster view
+    res = c.execute("explain analyze " + Q1)
+    text = "\n".join(r[0] for r in res.rows)
+    assert "Cluster memory:" in text

@@ -1,0 +1,511 @@
+"""Cluster memory governance: pool hierarchy, disk spill tier, killer
+policies, memory-aware retry sizing, resource-group memory limits.
+
+Reference analogs: TestMemoryPools (node pool + per-query reservations),
+TestFileSingleStreamSpiller (checksummed spill files),
+TestTotalReservationOnBlockedNodesLowMemoryKiller (victim determinism),
+TestPartitionMemoryEstimator (peak-driven retry budgets) and the
+resource-group memory-limit tests.
+
+Everything here is in-process (no worker spawns — the process-level
+integration rides tests/test_chaos.py's module cluster).
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.exec.memory import (DiskSpilledPage, NodeMemoryExceededError,
+                                   NodeMemoryPool, QueryMemoryPool,
+                                   SpilledPage, spill_pages)
+from trino_tpu.exec.serde import (parse_spill_frame, read_spill_file,
+                                  spill_frame, write_spill_file)
+from trino_tpu.parallel.cluster_memory import (ClusterMemoryManager,
+                                               MemoryEstimator,
+                                               QueryKilledError, killer_for)
+from trino_tpu.parallel.fault import (INSUFFICIENT_RESOURCES,
+                                      DecayingFailureStats,
+                                      classify_error_code)
+from trino_tpu.resource_groups import (ResourceGroupManager,
+                                       ResourceGroupSpec)
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql.analyzer import Session
+from trino_tpu.types import TrinoError
+
+AGG_SQL = ("select l_orderkey, sum(l_quantity) qty from lineitem "
+           "group by l_orderkey order by qty desc, l_orderkey limit 10")
+JOIN_SQL = ("select o_orderpriority, count(*) from orders o, lineitem l "
+            "where o.o_orderkey = l.l_orderkey and l_quantity > 30 "
+            "group by o_orderpriority order by o_orderpriority")
+SORT_SQL = "select * from lineitem order by l_extendedprice, l_orderkey"
+
+
+def make_runner(**props):
+    session = Session(catalog="tpch", schema="micro")
+    session.properties.update(props)
+    return LocalQueryRunner({"tpch": TpchConnector(page_rows=1024)},
+                            session, desired_splits=8)
+
+
+@pytest.fixture(scope="module")
+def baselines():
+    r = make_runner()
+    return {sql: r.execute(sql).rows
+            for sql in (AGG_SQL, JOIN_SQL, SORT_SQL)}
+
+
+# ------------------------------------------------- disk spill oracle ----
+
+
+@pytest.mark.parametrize("sql,cap", [(AGG_SQL, 600_000),
+                                     (JOIN_SQL, 150_000),
+                                     (SORT_SQL, 1_000_000)])
+def test_disk_spill_oracle(sql, cap, baselines):
+    """agg / join / sort forced through the DISK tier
+    (spill_host_memory_bytes=0 demotes every parked page) must return
+    byte-equal rows to the unconstrained run — the acceptance bar for
+    the spill subsystem."""
+    r = make_runner(query_max_memory_bytes=cap, spill_enabled=True,
+                    spill_to_disk_enabled=True, spill_host_memory_bytes=0)
+    res = r.execute(sql)
+    mem = res.stats["memory"]
+    assert mem["spill_events"] > 0
+    assert mem["disk_spill_events"] > 0, mem
+    assert mem["disk_spilled_bytes"] > 0
+    if sql is SORT_SQL:
+        # ties make exact order plan-dependent: compare multiset + keys
+        assert sorted(res.rows) == sorted(baselines[sql])
+    else:
+        assert res.rows == baselines[sql]
+
+
+def test_disk_spill_files_reaped_after_query():
+    r = make_runner(query_max_memory_bytes=600_000, spill_enabled=True,
+                    spill_to_disk_enabled=True, spill_host_memory_bytes=0)
+    res = r.execute(AGG_SQL)
+    assert res.stats["memory"]["disk_spill_events"] > 0
+    root = os.path.join("/tmp/trino_tpu_spill", str(os.getpid()))
+    leftovers = []
+    if os.path.isdir(root):
+        for d in os.listdir(root):
+            leftovers.extend(os.listdir(os.path.join(root, d)))
+    assert leftovers == []
+
+
+def test_host_tier_preferred_until_ledger_full(baselines):
+    """With a roomy host budget the disk tier must stay cold — the
+    tiers are ordered, not parallel."""
+    r = make_runner(query_max_memory_bytes=600_000, spill_enabled=True,
+                    spill_to_disk_enabled=True,
+                    spill_host_memory_bytes=1 << 30)
+    res = r.execute(AGG_SQL)
+    mem = res.stats["memory"]
+    assert mem["spill_events"] > 0
+    assert mem["disk_spill_events"] == 0
+    assert res.rows == baselines[AGG_SQL]
+
+
+# ------------------------------------------------- spill frame serde ----
+
+
+def _arrays():
+    cols = [np.arange(64, dtype=np.int64),
+            np.linspace(0, 1, 64).astype(np.float64)]
+    nulls = [np.zeros(64, dtype=bool), (np.arange(64) % 7 == 0)]
+    valid = np.arange(64) < 50
+    return cols, nulls, valid
+
+
+def test_spill_frame_roundtrip(tmp_path):
+    cols, nulls, valid = _arrays()
+    c2, n2, v2 = parse_spill_frame(spill_frame(cols, nulls, valid))
+    for a, b in zip(cols + nulls + [valid], c2 + n2 + [v2]):
+        assert a.dtype == b.dtype
+        assert np.array_equal(a, b)
+    path = str(tmp_path / "s.bin")
+    write_spill_file(path, cols, nulls, valid)
+    assert not os.path.exists(path + ".tmp")  # atomic: no temp residue
+    c3, n3, v3 = read_spill_file(path)
+    assert np.array_equal(c3[0], cols[0]) and np.array_equal(v3, valid)
+
+
+def test_spill_frame_detects_corruption(tmp_path):
+    cols, nulls, valid = _arrays()
+    frame = bytearray(spill_frame(cols, nulls, valid))
+    frame[20] ^= 0xFF  # flip a body byte: CRC must catch it
+    with pytest.raises(TrinoError):
+        parse_spill_frame(bytes(frame))
+    with pytest.raises(TrinoError):
+        parse_spill_frame(frame[: len(frame) // 2])  # torn frame
+
+
+def test_disk_spilled_page_roundtrip():
+    import jax.numpy as jnp
+
+    from trino_tpu import types as T
+    from trino_tpu.block import DevicePage
+
+    page = DevicePage([T.BIGINT], [jnp.arange(32, dtype=jnp.int64)],
+                      [jnp.zeros(32, dtype=bool)],
+                      jnp.arange(32) < 20, [None])
+    pool = QueryMemoryPool(1 << 20, spill_enabled=True,
+                           spill_to_disk=True, host_spill_limit=0)
+    pages = [page]
+    freed = spill_pages(pages, pool)
+    assert freed > 0
+    assert isinstance(pages[0], DiskSpilledPage)
+    assert os.path.exists(pages[0].path)
+    back = pages[0].to_device()
+    assert np.array_equal(np.asarray(back.cols[0])[:20], np.arange(20))
+    assert int(np.asarray(back.valid).sum()) == 20
+    pool.close()
+
+
+# ------------------------------------------- node pool (cross-query) ----
+
+
+def test_node_pool_cross_query_revoke_largest_first():
+    node = NodeMemoryPool(1000)
+    a = node.create_query_pool("qa", 1000, spill_enabled=True)
+    b = node.create_query_pool("qb", 1000, spill_enabled=True)
+    order = []
+    ca = a.create_context("a-op")
+    cb = b.create_context("b-op")
+    ca.set_revoke_callback(lambda: order.append("qa") or 600)
+    cb.set_revoke_callback(lambda: order.append("qb") or 300)
+    ca.reserve(600)
+    cb.reserve(300)
+    assert node.reserved == 900
+    # qc needs 500: node over budget -> revoke qa (largest) only
+    c = node.create_query_pool("qc", 1000, spill_enabled=True)
+    cc = c.create_context("c-op")
+    cc.reserve(500)
+    assert order == ["qa"]
+    assert node.reserved == 300 + 500
+    assert node.cross_query_revokes == 1
+
+
+def test_node_pool_blocked_raises_insufficient_resources():
+    node = NodeMemoryPool(1000)
+    a = node.create_query_pool("qa", 1000, spill_enabled=False)
+    a.create_context("x").reserve(900)
+    b = node.create_query_pool("qb", 1000, spill_enabled=False)
+    with pytest.raises(NodeMemoryExceededError) as exc:
+        b.create_context("y").reserve(500)
+    assert classify_error_code(exc.value.code) == INSUFFICIENT_RESOURCES
+    assert node.blocked_events == 1
+    assert node.snapshot()["blocked_events"] == 1
+    # the failed reservation must not leak into either pool
+    assert b.reserved == 0
+    assert node.reserved == 900
+
+
+def test_node_pool_snapshot_tracks_per_query_and_release():
+    node = NodeMemoryPool(1 << 20)
+    a = node.create_query_pool("qa", 1 << 20)
+    a.create_context("x").reserve(1234)
+    snap = node.snapshot()
+    assert snap["queries"]["qa"]["reserved"] == 1234
+    node.release_query("qa")
+    assert node.reserved == 0
+    # released peaks survive for the retry estimator
+    assert node.snapshot()["queries"]["qa"]["peak"] == 1234
+
+
+# ------------------------------------------------- killer policies ------
+
+
+def _snap(worker_id, blocked, queries, max_bytes=1000):
+    return {"max_bytes": max_bytes,
+            "reserved_bytes": sum(q["reserved"] for q in queries.values()),
+            "blocked_events": 1 if blocked else 0,
+            "queries": queries}
+
+
+def test_killer_blocked_nodes_policy_is_deterministic():
+    mgr = ClusterMemoryManager("total-reservation-on-blocked-nodes")
+    # node 0 blocked: qa holds 70 there; node 1 healthy: qb holds 900
+    mgr.update(0, _snap(0, True, {"qa": {"reserved": 70, "peak": 70},
+                                  "qb": {"reserved": 30, "peak": 30}}))
+    mgr.update(1, _snap(1, False, {"qb": {"reserved": 900, "peak": 900}}))
+    # blocked-nodes policy ignores qb's off-node bulk: qa dies
+    assert mgr.maybe_kill() == "qa"
+    with pytest.raises(QueryKilledError) as exc:
+        mgr.check_killed("qa")
+    assert exc.value.code == "EXCEEDED_CLUSTER_MEMORY"
+    assert classify_error_code(exc.value.code) == INSUFFICIENT_RESOURCES
+    # the flag was consumed: the retry attempt runs clean
+    mgr.check_killed("qa")
+
+
+def test_killer_total_reservation_policy():
+    mgr = ClusterMemoryManager("total-reservation")
+    mgr.update(0, _snap(0, True, {"qa": {"reserved": 70, "peak": 0},
+                                  "qb": {"reserved": 30, "peak": 0}}))
+    mgr.update(1, _snap(1, False, {"qb": {"reserved": 900, "peak": 0}}))
+    assert mgr.maybe_kill() == "qb"  # cluster-wide largest
+
+
+def test_killer_tie_breaks_lexicographically():
+    mgr = ClusterMemoryManager("total-reservation-on-blocked-nodes")
+    mgr.update(0, _snap(0, True, {"qz": {"reserved": 50, "peak": 0},
+                                  "qa": {"reserved": 50, "peak": 0}}))
+    assert mgr.maybe_kill() == "qa"
+
+
+def test_killer_none_policy_and_no_blocked_nodes():
+    mgr = ClusterMemoryManager("none")
+    mgr.update(0, _snap(0, True, {"qa": {"reserved": 50, "peak": 0}}))
+    assert mgr.maybe_kill() is None
+    mgr2 = ClusterMemoryManager("total-reservation-on-blocked-nodes")
+    mgr2.update(0, _snap(0, False, {"qa": {"reserved": 50, "peak": 0}}))
+    assert mgr2.maybe_kill() is None
+    with pytest.raises(TrinoError):
+        killer_for("bogus")
+
+
+def test_killer_fires_once_per_victim():
+    """Worker snapshots keep naming a dying victim for a few
+    heartbeats, and the victim popping its flag must not re-register:
+    one pressure episode = one kill, one event."""
+    mgr = ClusterMemoryManager("total-reservation-on-blocked-nodes")
+    snap = _snap(0, True, {"qa": {"reserved": 70, "peak": 70}})
+    mgr.update(0, snap)
+    assert mgr.maybe_kill() == "qa"
+    with pytest.raises(QueryKilledError):
+        mgr.check_killed("qa")           # flag consumed
+    mgr.update(0, snap)                  # stale heartbeat, still blocked
+    assert mgr.maybe_kill() is None      # no duplicate kill
+    assert mgr.kill_count == 1
+
+
+def test_blocked_delta_survives_interleaved_heartbeats():
+    """A heartbeat that stores a blocked delta without a governance
+    tick must not lose the signal when the next (unblocked) heartbeat
+    arrives: deltas accumulate until a kill consumes them."""
+    mgr = ClusterMemoryManager("total-reservation-on-blocked-nodes")
+    mgr.update(0, _snap(0, True, {"qa": {"reserved": 70, "peak": 0}}))
+    # next ping: worker's delta already consumed -> blocked_events 0
+    mgr.update(0, _snap(0, False, {"qa": {"reserved": 70, "peak": 0}}))
+    assert mgr.maybe_kill() == "qa"
+
+
+def test_blocked_signal_not_latched_past_a_no_victim_tick():
+    """A pressure episode that resolves before governance runs (the
+    blocking query failed and released) must not leave the node marked
+    blocked: the tick that found no victim consumes the signal, so a
+    later innocent query is not killed."""
+    mgr = ClusterMemoryManager("total-reservation-on-blocked-nodes")
+    mgr.update(0, _snap(0, True, {}))     # blocked, nothing killable
+    assert mgr.maybe_kill() is None
+    # innocent newcomer, no new blocked events
+    mgr.update(0, _snap(0, False, {"qb": {"reserved": 50, "peak": 0}}))
+    assert mgr.maybe_kill() is None
+    assert mgr.kill_count == 0
+
+
+def test_query_max_total_memory_cap_kills():
+    mgr = ClusterMemoryManager("none", query_max_total_bytes=100)
+    mgr.update(0, _snap(0, False, {"qa": {"reserved": 80, "peak": 0}}))
+    mgr.update(1, _snap(1, False, {"qa": {"reserved": 60, "peak": 0}}))
+    assert mgr.maybe_kill() == "qa"  # 140 > 100 across nodes
+    stats = mgr.cluster_stats()
+    assert stats["kills"] == 1 and stats["workers"] == 2
+
+
+# --------------------------------------------- estimator + escalation ---
+
+
+def test_memory_estimator_grows_from_observed_peak():
+    est = MemoryEstimator()
+    est.record_peak("q7a0", 500_000)
+    est.record_peak("q7a0", 400_000)      # lower later peak: keep max
+    assert est.peak_for("q7a0") == 500_000
+    # 2x observed peak wins over the failed budget when peak is larger
+    assert est.next_budget("q7a0", 120_000, 0) == 1_000_000
+    # floor wins when both are tiny
+    assert est.next_budget("q7a0", 120_000, 8 << 20) == 8 << 20
+    # no observation: grow from the failed budget itself
+    assert est.next_budget("q9a1", 300_000, 0) == 600_000
+
+
+# --------------------------------------------- decaying failure stats ---
+
+
+def test_decaying_failure_stats_halve_per_half_life():
+    s = DecayingFailureStats(half_life_s=60.0)
+    s.record(now=0.0)
+    assert s.score(now=0.0) == pytest.approx(1.0)
+    assert s.score(now=60.0) == pytest.approx(0.5, rel=1e-3)
+    s.record(now=60.0)
+    assert s.score(now=60.0) == pytest.approx(1.5, rel=1e-3)
+    assert s.score(now=180.0) == pytest.approx(1.5 / 4, rel=1e-3)
+    assert s.total == 2
+
+
+def test_prefer_healthy_placement():
+    from trino_tpu.parallel.process_runner import prefer_healthy
+
+    class W:
+        def __init__(self):
+            self.failure_stats = DecayingFailureStats()
+
+    good, bad = W(), W()
+    bad.failure_stats.record()
+    assert prefer_healthy([bad, good]) == [good]
+    # nobody healthy: fall back to everyone rather than starve
+    good.failure_stats.record()
+    assert prefer_healthy([bad, good]) == [bad, good]
+
+
+# --------------------------------------------- resource group limits ----
+
+
+def test_resource_group_hard_memory_limit_blocks_admission():
+    mgr = ResourceGroupManager([ResourceGroupSpec(
+        "g", max_concurrency=10, hard_memory_limit_bytes=1000)])
+    g = mgr.select("alice")
+    g.acquire(memory_bytes=700)
+    admitted = threading.Event()
+
+    def second():
+        g.acquire(timeout=5, memory_bytes=700)  # 1400 > 1000: waits
+        admitted.set()
+
+    t = threading.Thread(target=second, daemon=True)
+    t.start()
+    assert not admitted.wait(0.2)
+    g.release(memory_bytes=700)    # frees headroom -> second admits
+    assert admitted.wait(5)
+    g.release(memory_bytes=700)
+
+
+def test_resource_group_soft_memory_limit_stops_new_admissions():
+    mgr = ResourceGroupManager([ResourceGroupSpec(
+        "g", max_concurrency=10, soft_memory_limit_bytes=500)])
+    g = mgr.select("alice")
+    g.acquire(memory_bytes=600)    # first query may overshoot the soft cap
+    admitted = threading.Event()
+
+    def second():
+        g.acquire(timeout=5, memory_bytes=10)
+        admitted.set()
+
+    threading.Thread(target=second, daemon=True).start()
+    assert not admitted.wait(0.2)  # soft-exceeded: no NEW admissions
+    g.release(memory_bytes=600)
+    assert admitted.wait(5)
+    g.release(memory_bytes=10)
+
+
+def test_resource_group_rejects_unsatisfiable_budget():
+    """A budget above the hard limit can never fit: reject loudly
+    instead of queueing forever."""
+    mgr = ResourceGroupManager([ResourceGroupSpec(
+        "g", hard_memory_limit_bytes=1000)])
+    g = mgr.select("alice")
+    with pytest.raises(TrinoError) as exc:
+        g.acquire(timeout=1, memory_bytes=2000)
+    assert exc.value.code == "QUERY_REJECTED"
+    assert g.running == 0 and g.memory_reserved == 0
+
+
+def test_resource_group_memory_limits_from_config():
+    mgr = ResourceGroupManager.from_config({"groups": [
+        {"name": "g", "soft_memory_limit_bytes": 123,
+         "hard_memory_limit_bytes": 456}]})
+    spec = mgr.select("anyone").spec
+    assert spec.soft_memory_limit_bytes == 123
+    assert spec.hard_memory_limit_bytes == 456
+
+
+# --------------------------------------------- surfaces ----------------
+
+
+def test_session_properties_registered():
+    from trino_tpu import session_properties as SP
+
+    for name in ("query_max_total_memory", "spill_to_disk_enabled",
+                 "memory_killer_policy", "retry_initial_memory",
+                 "node_max_memory_bytes", "spill_host_memory_bytes",
+                 "scan_coalesce_enabled"):
+        assert name in SP.REGISTRY, name
+    props = {}
+    SP.set_property(props, "memory_killer_policy", "TOTAL-RESERVATION")
+    assert props["memory_killer_policy"] == "total-reservation"
+    with pytest.raises(TrinoError):
+        SP.set_property(props, "memory_killer_policy", "nuke-everything")
+
+
+def test_protocol_stats_carry_recovery_and_cluster_memory():
+    from trino_tpu.runner import QueryResult
+    from trino_tpu.server.protocol import ProtocolServer
+    from trino_tpu import types as T
+
+    class Stub:
+        def execute(self, sql):
+            return QueryResult(["x"], [T.BIGINT], [(1,)], stats={
+                "memory": {"peak_bytes": 7},
+                "recovery": {"task_attempts": 3},
+                "cluster_memory": {"workers": 2, "kills": 1},
+            })
+
+    srv = ProtocolServer(Stub()).start()
+    try:
+        import json
+        import urllib.request
+
+        doc = json.loads(urllib.request.urlopen(urllib.request.Request(
+            f"{srv.uri}/v1/statement", data=b"select 1",
+            method="POST")).read())
+        for _ in range(100):
+            if "data" in doc or "error" in doc:
+                break
+            doc = json.loads(
+                urllib.request.urlopen(doc["nextUri"]).read())
+        assert doc["stats"]["recovery"]["task_attempts"] == 3
+        assert doc["stats"]["clusterMemory"]["kills"] == 1
+        assert doc["stats"]["memory"]["peak_bytes"] == 7
+    finally:
+        srv.stop()
+
+
+def test_scan_coalesce_upload_batches():
+    """Split-fragmented small pages coalesce to the connector page size
+    before upload: one device batch instead of eight."""
+    from trino_tpu.ops.operator import TableScanOperator
+
+    conn = TpchConnector(page_rows=512)
+    meta = conn.metadata()
+    table = meta.get_table_handle("micro", "lineitem")
+    cols = meta.get_columns(table)
+    counts, totals = {}, {}
+    for coalesce in (None, 1 << 16):
+        scan = TableScanOperator(conn, cols, coalesce_rows=coalesce)
+        for s in conn.split_manager().get_splits(table, 8):
+            scan.add_split(s)
+        scan.no_more_splits()
+        pages = []
+        while True:
+            p = scan.get_output()
+            if p is None and scan.is_finished():
+                break
+            if p is not None:
+                pages.append(p)
+        counts[coalesce] = len(pages)
+        totals[coalesce] = sum(int(np.asarray(p.valid).sum())
+                               for p in pages)
+    assert totals[None] == totals[1 << 16]  # never changes row counts
+    assert counts[None] > 1
+    assert counts[1 << 16] == 1
+
+
+def test_local_explain_analyze_shows_disk_spill():
+    r = make_runner(query_max_memory_bytes=600_000, spill_enabled=True,
+                    spill_to_disk_enabled=True, spill_host_memory_bytes=0)
+    res = r.execute("explain analyze " + AGG_SQL)
+    text = "\n".join(row[0] for row in res.rows)
+    assert "disk" in text and "spills" in text

@@ -88,7 +88,9 @@ def build_q1_driver(conn: TpchConnector, schema: str = "tiny",
                                   hash_grouping=hash_grouping)
     sink = OutputCollectorOperator()
     if source_pages is not None:
-        driver = Driver([ValuesOperator(source_pages), fp, agg, sink],
+        driver = Driver([ValuesOperator(source_pages,
+                                        coalesce_rows=conn.page_rows),
+                         fp, agg, sink],
                         collect_stats=collect_stats)
     else:
         scan = TableScanOperator(conn, scan_cols)
@@ -176,7 +178,8 @@ def build_q18_driver(li_pages: Sequence[Page],
 
     proc = _cached(("q18", tuple(map(str, out_t))), build)
     sink = OutputCollectorOperator()
-    driver = Driver([ValuesOperator(list(li_pages)), agg,
+    driver = Driver([ValuesOperator(list(li_pages),
+                                    coalesce_rows=1 << 16), agg,
                      FilterProjectOperator(proc), sink],
                     collect_stats=collect_stats)
     return driver, sink
@@ -272,7 +275,8 @@ def build_q3_drivers(cust_pages: Sequence[Page],
 
     # pipeline A: customer -> mktsegment filter -> build(custkey)
     b1 = JoinBridge()
-    da = Driver([ValuesOperator(list(cust_pages)),
+    da = Driver([ValuesOperator(list(cust_pages),
+                                coalesce_rows=1 << 16),
                  FilterProjectOperator(proc_c),
                  HashBuilderOperator(proc_c.output_types, [0], b1)],
                 collect_stats=collect_stats)
@@ -281,7 +285,8 @@ def build_q3_drivers(cust_pages: Sequence[Page],
     # trim to (orderkey, orderdate, shippriority) -> build(orderkey)
     semi = LookupJoinOperator(proc_o.output_types, [1], b1, "semi")
     b2 = JoinBridge()
-    db = Driver([ValuesOperator(list(ord_pages)),
+    db = Driver([ValuesOperator(list(ord_pages),
+                                coalesce_rows=1 << 16),
                  FilterProjectOperator(proc_o), semi,
                  FilterProjectOperator(proc_t),
                  HashBuilderOperator(proc_t.output_types, [0], b2)],
@@ -300,7 +305,8 @@ def build_q3_drivers(cust_pages: Sequence[Page],
                         [SortKey(3, ascending=False),
                          SortKey(1, ascending=True)], 10)
     sink = OutputCollectorOperator()
-    dc = Driver([ValuesOperator(list(li_pages)),
+    dc = Driver([ValuesOperator(list(li_pages),
+                                coalesce_rows=1 << 16),
                  FilterProjectOperator(proc_l), probe, agg, topn, sink],
                 collect_stats=collect_stats)
     return [da, db, dc], sink

@@ -52,6 +52,17 @@ class WorkerReplacedEvent:
 
 
 @dataclass(frozen=True)
+class MemoryKillEvent:
+    """The cluster memory manager killed a query to relieve blocked
+    worker pools (or a query_max_total_memory breach)."""
+
+    query_id: str
+    policy: str                     # killer policy name
+    reserved_bytes: int             # victim's cluster-wide reservation
+    time: float
+
+
+@dataclass(frozen=True)
 class TaskRetryEvent:
     """A task or query attempt was retried (or speculatively
     re-dispatched) after a classified failure."""
@@ -77,6 +88,9 @@ class EventListener:
         pass
 
     def task_retry(self, event: TaskRetryEvent):
+        pass
+
+    def memory_kill(self, event: MemoryKillEvent):
         pass
 
 
@@ -117,6 +131,13 @@ class EventListenerManager:
         for listener in self.listeners:
             try:
                 listener.task_retry(event)
+            except Exception:
+                pass
+
+    def fire_memory_kill(self, event: MemoryKillEvent):
+        for listener in self.listeners:
+            try:
+                listener.memory_kill(event)
             except Exception:
                 pass
 

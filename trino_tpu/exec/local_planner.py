@@ -111,6 +111,7 @@ class LocalExecutionPlanner:
                  dynamic_filtering: bool = True,
                  page_sink_factory=None,
                  hash_grouping: bool = True,
+                 scan_coalesce: bool = True,
                  adaptive_partial_agg: bool = True,
                  adaptive_partial_ratio: float = ADAPTIVE_RATIO_THRESHOLD,
                  adaptive_partial_min_rows: int = ADAPTIVE_MIN_ROWS):
@@ -120,6 +121,9 @@ class LocalExecutionPlanner:
         self.task_count = task_count
         self.exchange_reader = exchange_reader
         self.memory_pool = memory_pool
+        #: coalesce split-tail scan pages up to the connector page size
+        #: before device upload (``scan_coalesce_enabled``)
+        self.scan_coalesce = scan_coalesce
         self.join_max_lanes = join_max_lanes
         self.dynamic_filtering = dynamic_filtering
         #: GROUP BY path: vectorized open-addressing hash table (default)
@@ -182,7 +186,10 @@ class LocalExecutionPlanner:
         columns = [c for _, c in node.assignments]
         scan = TableScanOperator(conn, columns,
                                  dynamic_filters=self._scan_dfs.pop(
-                                     id(node), []))
+                                     id(node), []),
+                                 coalesce_rows=getattr(
+                                     conn, "page_rows", None)
+                                 if self.scan_coalesce else None)
         splits = conn.split_manager().get_splits(node.table,
                                                  self.desired_splits)
         for i, split in enumerate(splits):

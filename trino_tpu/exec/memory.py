@@ -1,33 +1,49 @@
-"""Per-query memory accounting + host-RAM spill.
+"""Memory governance: node-wide + per-query pools, host-RAM and disk
+spill tiers.
 
-Reference analog: ``memory/MemoryPool.java`` (per-node pool with per-query
-reservations), ``lib/trino-memory-context`` (the AggregatedMemoryContext
-tree charged by operators), ``execution/MemoryRevokingScheduler.java:48``
-(pool pressure -> revoke largest revocable operators) and
-``spiller/FileSingleStreamSpiller.java`` (the spill target).
+Reference analog: ``memory/MemoryPool.java`` (ONE pool per node shared by
+every query, with per-query reservations), ``lib/trino-memory-context``
+(the AggregatedMemoryContext tree charged by operators),
+``execution/MemoryRevokingScheduler.java:48`` (pool pressure -> revoke
+largest revocable operators) and ``spiller/FileSingleStreamSpiller.java``
+(the disk spill target with its checksummed page frames).
 
-TPU redesign: the scarce resource is device HBM and the spill target is
-host RAM — a device->host transfer of retained ``DevicePage``s into numpy
-arrays, not a file write.  Stateful operators (aggregation partials, join
-build pages, sort buffers) charge the padded byte size of every retained
-page to a per-query ``QueryMemoryPool``; a reservation that would exceed
-``query_max_memory_bytes`` first revokes revocable contexts largest-first
-(when ``spill_enabled``), then fails the query with
-EXCEEDED_MEMORY_LIMIT if still over — the same admission discipline as
-the reference pool's blocking reserve, made synchronous because our
-drivers are synchronous.
+TPU redesign: the scarce resource is device HBM.  Spill degrades in two
+tiers — device->host (a ``DevicePage`` parked as numpy arrays in a
+``SpilledPage``) and host->disk (a ``DiskSpilledPage`` holding a
+CRC-framed, atomically-written spill file; see ``serde.spill_frame``) —
+so a query under pressure degrades incrementally instead of failing
+("Robust Dynamic Hybrid Hash Join"'s discipline).  Pool hierarchy:
+
+  NodeMemoryPool            one per worker process, all queries charge it
+    QueryMemoryPool         per (query, worker): query_max_memory_bytes
+      OperatorMemoryContext per stateful operator (agg/join/sort)
+
+A reservation that would exceed the query cap first revokes the query's
+own revocable contexts largest-first (when ``spill_enabled``); one that
+would exceed the NODE cap revokes across queries largest-first; still
+over => MemoryExceededError (EXCEEDED_LOCAL_MEMORY_LIMIT) respectively
+NodeMemoryExceededError (EXCEEDED_NODE_MEMORY) — both
+INSUFFICIENT_RESOURCES, so the coordinator's memory-aware retry can
+re-admit with a grown budget.  Host-RAM residency of spilled state is
+tracked by a ``HostSpillLedger`` (node-wide when a node pool exists);
+crossing its limit demotes the largest spilled pages to disk when
+``spill_to_disk_enabled``.
 
 Locking: the pool lock and context locks are never held together —
 revoke callbacks run under the victim context's lock only (so they can't
 stall other threads' reserve/free), and pool bookkeeping for the freed
-bytes happens after the context lock is released.  Operators must mutate
+bytes happens after the context lock is released.  The node pool's lock
+is likewise never held across a revoke callback.  Operators must mutate
 spillable state only under their context lock so a revoke from another
 thread cannot interleave with ``add_input``.
 """
 
 from __future__ import annotations
 
+import os
 import threading
+import weakref
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
@@ -47,9 +63,30 @@ class MemoryExceededError(TrinoError):
         self.limit = limit
 
 
+class NodeMemoryExceededError(TrinoError):
+    """The worker-wide pool is exhausted across ALL queries and
+    cross-query revocation could not free enough (reference: the node
+    MemoryPool blocking with no revocable bytes left)."""
+
+    def __init__(self, requested: int, reserved: int, limit: int,
+                 query_id: str = ""):
+        super().__init__(
+            f"Worker memory pool exhausted: node limit {limit} bytes, "
+            f"reserved {reserved} across all queries, query "
+            f"{query_id or '?'} requested {requested} more",
+            "EXCEEDED_NODE_MEMORY")
+        self.requested = requested
+        self.reserved = reserved
+        self.limit = limit
+
+
 def device_page_bytes(page) -> int:
     """Accounted HBM footprint of a DevicePage: padded columns + null
-    masks + the valid mask."""
+    masks + the valid mask.  Disk-parked pages carry their recorded
+    footprint (their arrays are not in RAM to measure)."""
+    hbm = getattr(page, "hbm_bytes", None)
+    if hbm is not None:
+        return hbm
     cap = page.capacity
     total = cap  # valid mask (bool = 1 byte)
     for c, n in zip(page.cols, page.nulls):
@@ -67,7 +104,8 @@ class SpilledPage:
     the host footprint and — more importantly — the HBM needed to bring
     the page back."""
 
-    __slots__ = ("types", "cols", "nulls", "valid", "dictionaries")
+    __slots__ = ("types", "cols", "nulls", "valid", "dictionaries",
+                 "__weakref__")
 
     def __init__(self, page):
         from ..block import padded_size
@@ -100,6 +138,14 @@ class SpilledPage:
     def capacity(self) -> int:
         return int(self.valid.shape[0])
 
+    def host_bytes(self) -> int:
+        return sum(c.nbytes for c in self.cols) \
+            + sum(n.nbytes for n in self.nulls) + self.valid.nbytes
+
+    def host(self) -> "SpilledPage":
+        """An in-RAM view of this page (disk-parked pages load here)."""
+        return self
+
     def to_device(self):
         import jax.numpy as jnp
 
@@ -112,16 +158,164 @@ class SpilledPage:
                           list(self.dictionaries))
 
 
-def spill_pages(pages: List) -> int:
+class DiskSpilledPage(SpilledPage):
+    """A SpilledPage demoted to a per-query spill file: the arrays live
+    on disk in one CRC-checked frame (``serde.spill_frame``), written
+    atomically; only types/dictionaries/footprint stay in RAM
+    (dictionaries are shared host-side objects — the page reloads in
+    this process, so pools need not be serialized).
+
+    Reference analog: ``spiller/FileSingleStreamSpiller.java`` — the
+    tier below host RAM."""
+
+    __slots__ = ("path", "_capacity", "hbm_bytes", "disk_bytes")
+
+    def __init__(self, spilled: SpilledPage, path: str):
+        # deliberately no super().__init__: the array slots stay unset
+        self.types = list(spilled.types)
+        self.dictionaries = list(spilled.dictionaries)
+        self.path = path
+        self._capacity = spilled.capacity
+        self.hbm_bytes = device_page_bytes(spilled)
+        self.disk_bytes = 0  # set by DiskSpiller after the write
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    def host(self) -> SpilledPage:
+        """Load the frame back into an in-RAM SpilledPage."""
+        from .serde import read_spill_file
+
+        cols, nulls, valid = read_spill_file(self.path)
+        page = SpilledPage.__new__(SpilledPage)
+        page.types = list(self.types)
+        page.dictionaries = list(self.dictionaries)
+        page.cols = cols
+        page.nulls = nulls
+        page.valid = valid
+        return page
+
+    def to_device(self):
+        return self.host().to_device()
+
+
+class HostSpillLedger:
+    """Live host-RAM bytes held by SpilledPages, node-wide when a node
+    pool exists.  Charged at spill time and discharged by a weakref
+    finalizer when the parked page is dropped (uploaded back or
+    demoted), so residency tracks actual lifetime, not call sites."""
+
+    def __init__(self, limit_bytes: Optional[int] = None):
+        self.limit_bytes = limit_bytes
+        self.resident_bytes = 0
+        self.peak_bytes = 0
+        self._lock = threading.Lock()
+
+    def charge(self, page: SpilledPage) -> None:
+        nbytes = page.host_bytes()
+        with self._lock:
+            self.resident_bytes += nbytes
+            self.peak_bytes = max(self.peak_bytes, self.resident_bytes)
+        weakref.finalize(page, self._discharge, nbytes)
+
+    def _discharge(self, nbytes: int) -> None:
+        with self._lock:
+            self.resident_bytes -= nbytes
+
+    def over_limit(self) -> bool:
+        with self._lock:
+            return self.limit_bytes is not None \
+                and self.resident_bytes > self.limit_bytes
+
+
+class DiskSpiller:
+    """Per-query spill-file manager: one directory per query, one
+    CRC-framed file per demoted page, atomic writes (reference:
+    ``FileSingleStreamSpiller`` + ``SpillerFactory``'s per-query
+    directories)."""
+
+    def __init__(self, query_id: str = "q"):
+        self.query_id = query_id
+        self._dir: Optional[str] = None
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.spill_events = 0
+        self.spilled_bytes = 0       # uncompressed bytes demoted
+        self.file_bytes = 0          # on-disk (compressed) bytes
+
+    def _next_path(self) -> str:
+        import tempfile
+
+        with self._lock:
+            if self._dir is None:
+                # env read per spiller, not at import: embedders may set
+                # the spill root after importing the package
+                root = os.environ.get("TRINO_TPU_SPILL_DIR",
+                                      "/tmp/trino_tpu_spill")
+                base = os.path.join(root, str(os.getpid()))
+                os.makedirs(base, exist_ok=True)
+                self._dir = tempfile.mkdtemp(
+                    prefix=f"{self.query_id}.", dir=base)
+            self._seq += 1
+            return os.path.join(self._dir, f"spill-{self._seq}.bin")
+
+    def spill(self, page: SpilledPage) -> DiskSpilledPage:
+        from .serde import write_spill_file
+
+        path = self._next_path()
+        disk = DiskSpilledPage(page, path)
+        nbytes = write_spill_file(path, page.cols, page.nulls, page.valid)
+        disk.disk_bytes = nbytes
+        with self._lock:
+            self.spill_events += 1
+            self.spilled_bytes += page.host_bytes()
+            self.file_bytes += nbytes
+        # the file dies with the page object (upload consumed it) or at
+        # close(), whichever first
+        weakref.finalize(disk, _remove_quiet, path)
+        return disk
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"disk_spill_events": self.spill_events,
+                    "disk_spilled_bytes": self.spilled_bytes,
+                    "disk_file_bytes": self.file_bytes}
+
+    def close(self):
+        import shutil
+
+        with self._lock:
+            d, self._dir = self._dir, None
+        if d is not None:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def _remove_quiet(path: str):
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+def spill_pages(pages: List, pool: "QueryMemoryPool" = None) -> int:
     """Convert DevicePage entries to SpilledPage in place (caller holds
-    the owning context's lock); returns the HBM bytes freed."""
+    the owning context's lock); returns the HBM bytes freed.  With a
+    pool, host residency is charged to its ledger and — when the ledger
+    is over its limit and disk spill is enabled — the largest parked
+    pages in this list demote to the disk tier."""
     from ..block import DevicePage
 
     freed = 0
     for i, p in enumerate(pages):
         if isinstance(p, DevicePage):
             freed += device_page_bytes(p)
-            pages[i] = SpilledPage(p)
+            spilled = SpilledPage(p)
+            if pool is not None:
+                pool.host_ledger.charge(spilled)
+            pages[i] = spilled
+    if pool is not None:
+        pool.maybe_demote(pages)
     return freed
 
 
@@ -148,7 +342,7 @@ def prepare_finish(ctx: "OperatorMemoryContext", pages: List):
         freed = 0
         if pool.spill_enabled and \
                 pool.reserved + uploads + 2 * total > pool.max_bytes:
-            freed = spill_pages(pages)
+            freed = spill_pages(pages, pool)
             total = sum(device_page_bytes(p) for p in pages)
             uploads = total
         # clear the callback INSIDE the lock: a concurrent pool revoke
@@ -198,21 +392,34 @@ class OperatorMemoryContext:
 
 
 class QueryMemoryPool:
-    """Per-query HBM accounting with synchronous revocation.
+    """Per-(query, node) HBM accounting with synchronous revocation.
 
-    Reference: ``memory/MemoryPool.java`` + ``QueryContext`` — collapsed
-    to one pool per query because device HBM is per-process here.
+    Reference: ``memory/MemoryPool.java``'s per-query reservation +
+    ``QueryContext``.  With a ``parent`` NodeMemoryPool every reservation
+    also charges the node; without one (single-query runners) the pool
+    stands alone.
     """
 
-    def __init__(self, max_bytes: int, spill_enabled: bool = False):
+    def __init__(self, max_bytes: int, spill_enabled: bool = False,
+                 spill_to_disk: bool = False,
+                 host_spill_limit: Optional[int] = None,
+                 parent: "NodeMemoryPool" = None,
+                 query_id: str = "q"):
         self.max_bytes = int(max_bytes)
         self.spill_enabled = spill_enabled
+        self.spill_to_disk = spill_to_disk
+        self.query_id = query_id
+        self.parent = parent
         self.reserved = 0
         self.peak_bytes = 0
         self.spill_events = 0
         self.spilled_bytes = 0
         self._lock = threading.Lock()
         self._contexts: List[OperatorMemoryContext] = []
+        self.host_ledger = parent.host_ledger if parent is not None \
+            else HostSpillLedger(host_spill_limit)
+        self.disk_spiller = DiskSpiller(query_id) if spill_to_disk \
+            else None
 
     def create_context(self, name: str) -> OperatorMemoryContext:
         ctx = OperatorMemoryContext(self, name)
@@ -220,44 +427,98 @@ class QueryMemoryPool:
             self._contexts.append(ctx)
         return ctx
 
+    # -- spill tiers ----------------------------------------------------
+
+    def maybe_demote(self, pages: List):
+        """Demote the largest in-RAM SpilledPages of this list to disk
+        while the host ledger is over its limit (the host tier stays the
+        fast path; disk absorbs the overflow).  Largest-first order is
+        fixed up front — one sort, not a rescan per demotion."""
+        if self.disk_spiller is None or not self.host_ledger.over_limit():
+            return
+        order = sorted(
+            (i for i, p in enumerate(pages)
+             if isinstance(p, SpilledPage)
+             and not isinstance(p, DiskSpilledPage)),
+            key=lambda i: -pages[i].host_bytes())
+        for i in order:
+            if not self.host_ledger.over_limit():
+                return
+            # the replaced SpilledPage's finalizer discharges the
+            # ledger as soon as the reference drops
+            pages[i] = self.disk_spiller.spill(pages[i])
+
     # -- internal (called by contexts) ----------------------------------
 
     def _reserve(self, ctx: OperatorMemoryContext, nbytes: int,
                  revocable: bool):
-        with self._lock:
-            if self.reserved + nbytes <= self.max_bytes:
-                self._admit_locked(ctx, nbytes, revocable)
-                return
-            if not self.spill_enabled:
-                raise MemoryExceededError(nbytes, self.reserved,
-                                          self.max_bytes)
+        self._reserve_local(ctx, nbytes, revocable)
+        if self.parent is not None:
+            try:
+                self.parent.reserve_for(self, nbytes)
+            except TrinoError:
+                # roll back the LOCAL admit only: the node charge never
+                # happened, so _free's parent uncharge must not run
+                with self._lock:
+                    self._free_locked(ctx, nbytes, revocable)
+                raise
+
+    def _reserve_local(self, ctx: OperatorMemoryContext, nbytes: int,
+                       revocable: bool):
+        # revoke-until-fit loop: a concurrent reserve may consume bytes
+        # another round of revocation just freed, so the target is
+        # re-derived under the lock each round and the request only
+        # fails once revocation stops making progress
+        while True:
+            with self._lock:
+                if self.reserved + nbytes <= self.max_bytes:
+                    self._admit_locked(ctx, nbytes, revocable)
+                    return
+                if not self.spill_enabled:
+                    raise MemoryExceededError(nbytes, self.reserved,
+                                              self.max_bytes)
+                needed = self.reserved + nbytes - self.max_bytes
             # requester's own state first: self-revoke is deadlock-free
             # (its RLock is reentrant on the calling thread) and the
             # largest state usually belongs to the operator asking for
             # more
+            if self.revoke_up_to(needed, prefer=ctx) <= 0:
+                break
+        with self._lock:
+            if self.reserved + nbytes > self.max_bytes:
+                raise MemoryExceededError(nbytes, self.reserved,
+                                          self.max_bytes)
+            self._admit_locked(ctx, nbytes, revocable)
+
+    def revoke_up_to(self, needed: int, prefer=None) -> int:
+        """Spill revocable contexts largest-first until ``needed`` bytes
+        came free (or no revocable state remains); returns the bytes
+        actually freed.  Runs WITHOUT the pool lock held: callbacks move
+        whole operator states device->host, and other threads'
+        reserve/free must not serialize behind that transfer (reference:
+        MemoryRevokingScheduler revokes asynchronously)."""
+        with self._lock:
             candidates = sorted(self._contexts,
-                                key=lambda c: (c is not ctx, -c.revocable))
-        # Revoke OUTSIDE the pool lock: callbacks move whole operator
-        # states device->host, and other threads' reserve/free must not
-        # serialize behind that transfer (reference:
-        # MemoryRevokingScheduler revokes asynchronously).
+                                key=lambda c: (c is not prefer,
+                                               -c.revocable))
+        total_freed = 0
         for c in candidates:
-            with self._lock:
-                if self.reserved + nbytes <= self.max_bytes:
-                    break
+            if total_freed >= needed:
+                break
             if c.revocable <= 0:
                 continue
             with c.lock:
                 cb = c._revoke_cb
                 freed = cb() if cb is not None else 0
             if freed > 0:
+                total_freed += freed
                 self.record_spill(freed)
                 self._free(c, freed, revocable=True)
+        return total_freed
+
+    def revocable_bytes(self) -> int:
         with self._lock:
-            if self.reserved + nbytes > self.max_bytes:
-                raise MemoryExceededError(nbytes, self.reserved,
-                                          self.max_bytes)
-            self._admit_locked(ctx, nbytes, revocable)
+            return sum(c.revocable for c in self._contexts)
 
     def _admit_locked(self, ctx, nbytes, revocable):
         self.reserved += nbytes
@@ -269,34 +530,184 @@ class QueryMemoryPool:
     def _free(self, ctx: OperatorMemoryContext, nbytes: int,
               revocable: bool):
         with self._lock:
-            self._free_locked(ctx, nbytes, revocable)
+            freed = self._free_locked(ctx, nbytes, revocable)
+        if freed and self.parent is not None:
+            self.parent.uncharge_for(self, freed)
 
-    def _free_locked(self, ctx, nbytes, revocable):
+    def _free_locked(self, ctx, nbytes, revocable) -> int:
         nbytes = min(nbytes, ctx.reserved)
         self.reserved -= nbytes
         ctx.reserved -= nbytes
         if revocable:
             ctx.revocable = max(0, ctx.revocable - nbytes)
+        return nbytes
 
     def record_spill(self, freed: int):
         with self._lock:
             self.spill_events += 1
             self.spilled_bytes += freed
 
+    def close(self):
+        """Release every context's residue and the disk spill directory
+        (end of the query's life on this node)."""
+        with self._lock:
+            contexts = list(self._contexts)
+        for c in contexts:
+            c.close()
+        if self.disk_spiller is not None:
+            self.disk_spiller.close()
+
     # -- observability ---------------------------------------------------
 
     def stats(self) -> Dict[str, int]:
-        return {
+        out = {
             "reserved_bytes": self.reserved,
             "peak_bytes": self.peak_bytes,
             "max_bytes": self.max_bytes,
             "spill_events": self.spill_events,
             "spilled_bytes": self.spilled_bytes,
         }
+        if self.disk_spiller is not None:
+            out.update(self.disk_spiller.stats())
+        return out
 
 
-def pool_from_session(session) -> QueryMemoryPool:
+class NodeMemoryPool:
+    """The worker-wide pool every concurrent query charges (reference:
+    ``memory/MemoryPool.java`` — the actual per-node general pool).
+
+    Over-budget reservations revoke across queries LARGEST-REVOCABLE-
+    first; a node that still cannot admit records a blocked event (the
+    signal the coordinator's low-memory killer keys on) and raises
+    EXCEEDED_NODE_MEMORY."""
+
+    def __init__(self, max_bytes: int,
+                 host_spill_limit: Optional[int] = None):
+        self.max_bytes = int(max_bytes)
+        self.reserved = 0
+        self.peak_bytes = 0
+        self.blocked_events = 0
+        self.cross_query_revokes = 0
+        self._lock = threading.Lock()
+        self._children: Dict[str, QueryMemoryPool] = {}
+        #: peaks of already-released queries, kept so a heartbeat after
+        #: the fast failure still feeds the retry MemoryEstimator
+        self._released_peaks: Dict[str, int] = {}
+        self.host_ledger = HostSpillLedger(host_spill_limit)
+
+    def create_query_pool(self, query_id: str, max_bytes: int,
+                          spill_enabled: bool = False,
+                          spill_to_disk: bool = False) -> QueryMemoryPool:
+        with self._lock:
+            pool = self._children.get(query_id)
+            if pool is None:
+                pool = QueryMemoryPool(
+                    max_bytes, spill_enabled, spill_to_disk,
+                    parent=self, query_id=query_id)
+                self._children[query_id] = pool
+            return pool
+
+    def release_query(self, query_id: str):
+        with self._lock:
+            pool = self._children.pop(query_id, None)
+            if pool is not None:
+                if len(self._released_peaks) >= 64:
+                    self._released_peaks.clear()
+                self._released_peaks[query_id] = pool.peak_bytes
+        if pool is not None:
+            pool.close()
+            # close() frees context residue, which uncharges us; any
+            # accounting drift dies with the child here
+            with self._lock:
+                self.reserved -= min(self.reserved, pool.reserved)
+
+    # -- charging (called by child pools, never under their lock) --------
+
+    def reserve_for(self, child: QueryMemoryPool, nbytes: int):
+        # revoke-until-fit (same discipline as the query pool): the
+        # target re-derives under the lock each round so concurrent
+        # admissions cannot turn a satisfiable request into a failure
+        # while revocable state remains
+        while True:
+            with self._lock:
+                if self.reserved + nbytes <= self.max_bytes:
+                    self._admit_locked(nbytes)
+                    return
+                needed = self.reserved + nbytes - self.max_bytes
+                # cross-query revocation, largest revocable first; the
+                # requester revokes last (its state is already
+                # host-bound if its own cap forced spill)
+                victims = sorted(self._children.values(),
+                                 key=lambda p: (p is child,
+                                                -p.revocable_bytes()))
+            round_freed = 0
+            for victim in victims:
+                if round_freed >= needed:
+                    break
+                if not victim.spill_enabled:
+                    continue
+                freed = victim.revoke_up_to(needed - round_freed)
+                if freed > 0:
+                    with self._lock:
+                        self.cross_query_revokes += 1
+                round_freed += freed
+            if round_freed <= 0:
+                break
+        with self._lock:
+            if self.reserved + nbytes > self.max_bytes:
+                self.blocked_events += 1
+                raise NodeMemoryExceededError(
+                    nbytes, self.reserved, self.max_bytes,
+                    child.query_id)
+            self._admit_locked(nbytes)
+
+    def _admit_locked(self, nbytes: int):
+        self.reserved += nbytes
+        self.peak_bytes = max(self.peak_bytes, self.reserved)
+
+    def uncharge_for(self, child: QueryMemoryPool, nbytes: int):
+        with self._lock:
+            self.reserved -= min(self.reserved, nbytes)
+
+    # -- observability ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The heartbeat-piggyback payload: node totals + per-query
+        reservations, the ClusterMemoryManager's input (reference:
+        MemoryInfo in the ServerInfo heartbeat).  ``blocked_events`` is
+        a DELTA consumed by the read: one blocked episode must trigger
+        at most one killer decision, not one per heartbeat forever."""
+        with self._lock:
+            queries = {qid: {"reserved": 0, "peak": peak, "spilled": 0}
+                       for qid, peak in self._released_peaks.items()}
+            queries.update({qid: {"reserved": p.reserved,
+                                  "peak": p.peak_bytes,
+                                  "spilled": p.spilled_bytes}
+                            for qid, p in self._children.items()})
+            blocked, self.blocked_events = self.blocked_events, 0
+            return {
+                "max_bytes": self.max_bytes,
+                "reserved_bytes": self.reserved,
+                "peak_bytes": self.peak_bytes,
+                "blocked_events": blocked,
+                "cross_query_revokes": self.cross_query_revokes,
+                "host_spill_resident": self.host_ledger.resident_bytes,
+                "queries": queries,
+            }
+
+
+def pool_from_session(session, parent: NodeMemoryPool = None,
+                      query_id: str = "q") -> QueryMemoryPool:
     from .. import session_properties as SP
 
-    return QueryMemoryPool(SP.value(session, "query_max_memory_bytes"),
-                           SP.value(session, "spill_enabled"))
+    if parent is not None:
+        return parent.create_query_pool(
+            query_id, SP.value(session, "query_max_memory_bytes"),
+            SP.value(session, "spill_enabled"),
+            SP.value(session, "spill_to_disk_enabled"))
+    return QueryMemoryPool(
+        SP.value(session, "query_max_memory_bytes"),
+        SP.value(session, "spill_enabled"),
+        SP.value(session, "spill_to_disk_enabled"),
+        host_spill_limit=SP.value(session, "spill_host_memory_bytes"),
+        query_id=query_id)

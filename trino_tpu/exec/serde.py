@@ -25,6 +25,87 @@ from .. import types as T
 from ..block import Block, Dictionary, Page
 
 _MAGIC = 0x54505047  # "TPPG"
+_SPILL_MAGIC = 0x54505350  # "TPSP"
+
+
+def spill_frame(cols: List[np.ndarray], nulls: List[np.ndarray],
+                valid: np.ndarray, compress: bool = True) -> bytes:
+    """One disk-spill frame: dtype-tagged raw buffers in a compressed,
+    CRC-checksummed envelope — the page-frame discipline applied to a
+    parked SpilledPage's arrays (reference:
+    ``spiller/FileSingleStreamSpiller``'s serialized page stream).
+    Dictionaries do NOT ride along: spill files are read back by the
+    process that wrote them, where pools are shared host objects."""
+    parts: List[bytes] = [struct.pack("<H", len(cols))]
+    for arr in [*cols, *nulls, valid]:
+        a = np.ascontiguousarray(arr)
+        tag = a.dtype.str.encode()
+        data = a.tobytes()
+        parts.append(struct.pack("<B", len(tag)))
+        parts.append(tag)
+        parts.append(struct.pack("<I", len(data)))
+        parts.append(data)
+    raw = b"".join(parts)
+    body = zlib.compress(raw, 1) if compress else raw
+    header = struct.pack("<IBII", _SPILL_MAGIC, 1 if compress else 0,
+                         len(raw), zlib.crc32(body))
+    return header + body
+
+
+def parse_spill_frame(frame: bytes):
+    """Inverse of ``spill_frame``; raises on any corruption (bad magic,
+    CRC mismatch, short frame) — a torn spill file must fail loudly,
+    never yield partial rows."""
+    if len(frame) < 13:
+        raise T.TrinoError("spill frame truncated",
+                           "GENERIC_INTERNAL_ERROR")
+    magic, compressed, raw_len, crc = struct.unpack_from("<IBII", frame, 0)
+    if magic != _SPILL_MAGIC:
+        raise T.TrinoError("bad spill frame magic",
+                           "GENERIC_INTERNAL_ERROR")
+    body = frame[13:]
+    if zlib.crc32(body) != crc:
+        raise T.TrinoError("spill frame checksum mismatch",
+                           "GENERIC_INTERNAL_ERROR")
+    raw = zlib.decompress(body) if compressed else body
+    if len(raw) != raw_len:
+        raise T.TrinoError("spill frame length mismatch",
+                           "GENERIC_INTERNAL_ERROR")
+    (ncols,) = struct.unpack_from("<H", raw, 0)
+    off = 2
+    arrays: List[np.ndarray] = []
+    for _ in range(2 * ncols + 1):
+        (tlen,) = struct.unpack_from("<B", raw, off)
+        off += 1
+        dtype = np.dtype(raw[off:off + tlen].decode())
+        off += tlen
+        (nbytes,) = struct.unpack_from("<I", raw, off)
+        off += 4
+        arrays.append(np.frombuffer(raw, dtype=dtype,
+                                    count=nbytes // dtype.itemsize,
+                                    offset=off).copy())
+        off += nbytes
+    return arrays[:ncols], arrays[ncols:2 * ncols], arrays[2 * ncols]
+
+
+def write_spill_file(path: str, cols, nulls, valid) -> int:
+    """Atomic spill write: frame to a sibling temp file, fsync, rename —
+    a crash mid-write leaves no half-frame under the final name."""
+    import os
+
+    frame = spill_frame(cols, nulls, valid)
+    tmp = f"{path}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(frame)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return len(frame)
+
+
+def read_spill_file(path: str):
+    with open(path, "rb") as f:
+        return parse_spill_frame(f.read())
 
 
 def _jsonable(v):

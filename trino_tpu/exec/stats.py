@@ -93,6 +93,10 @@ class QueryStatsTree:
     stages: List[StageStatsTree] = field(default_factory=list)
     wall_ms: float = 0.0
     memory: Optional[Dict] = None
+    #: ClusterMemoryManager.cluster_stats(): worker count, cluster-wide
+    #: reserved/max bytes, blocked nodes, low-memory kills + policy —
+    #: the coordinator's memory-governance view of this query's run
+    cluster_memory: Optional[Dict] = None
     #: self-healing counters for this query (fault.RecoveryStats dict):
     #: attempts, retries by error type, backoff wall-time, workers
     #: replaced, speculative launches/wins — attached by the process
@@ -103,9 +107,23 @@ class QueryStatsTree:
         return {
             "wall_ms": round(self.wall_ms, 2),
             "memory": self.memory,
+            "cluster_memory": self.cluster_memory,
             "recovery": self.recovery,
             "stages": [s.to_dict() for s in self.stages],
         }
+
+    def cluster_memory_line(self) -> Optional[str]:
+        """One EXPLAIN ANALYZE line for the cluster memory view; None
+        when no worker reported a pool (local runs stay clean)."""
+        cm = self.cluster_memory
+        if not cm or not cm.get("workers"):
+            return None
+        return (f"Cluster memory: {cm.get('total_reserved_bytes', 0)} / "
+                f"{cm.get('total_max_bytes', 0)} bytes reserved over "
+                f"{cm['workers']} workers, "
+                f"{cm.get('blocked_nodes', 0)} blocked, "
+                f"{cm.get('kills', 0)} kills "
+                f"[{cm.get('killer_policy', 'none')}]")
 
     def recovery_line(self) -> Optional[str]:
         """One EXPLAIN ANALYZE line summarizing what self-healing did;
@@ -137,10 +155,19 @@ class QueryStatsTree:
         lines: List[str] = []
         lines.append(f"Query: {self.wall_ms:.1f}ms")
         if self.memory:
+            disk = ""
+            if self.memory.get("disk_spill_events") is not None:
+                disk = (f", disk {self.memory['disk_spill_events']} "
+                        f"files "
+                        f"({self.memory.get('disk_spilled_bytes', 0)} "
+                        f"bytes)")
             lines.append(
                 f"Memory: peak {self.memory.get('peak_bytes', 0)} bytes, "
                 f"{self.memory.get('spill_events', 0)} spills "
-                f"({self.memory.get('spilled_bytes', 0)} bytes)")
+                f"({self.memory.get('spilled_bytes', 0)} bytes)" + disk)
+        cm_line = self.cluster_memory_line()
+        if cm_line:
+            lines.append(cm_line)
         rec_line = self.recovery_line()
         if rec_line:
             lines.append(rec_line)

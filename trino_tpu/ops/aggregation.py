@@ -504,10 +504,11 @@ class HashAggregationOperator(Operator):
 
     def _revoke(self) -> int:
         """Park device partials in host RAM (called by the pool under
-        this context's lock; reference: Operator.startMemoryRevoke)."""
+        this context's lock; reference: Operator.startMemoryRevoke),
+        overflowing to the disk tier when the host ledger is full."""
         from ..exec.memory import spill_pages
 
-        return spill_pages(self._partials)
+        return spill_pages(self._partials, self._ctx.pool)
 
     def _aggregate_page(self, page: DevicePage,
                         intermediate: bool) -> DevicePage:

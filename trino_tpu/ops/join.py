@@ -203,10 +203,10 @@ class HashBuilderOperator(Operator):
     def _revoke(self) -> int:
         """Park build pages in host RAM until publish (reference:
         HashBuilderOperator's CONSUMING_INPUT -> SPILLING_INPUT states —
-        here the spill target is host RAM, not disk)."""
+        with the disk tier below host RAM when the ledger overflows)."""
         from ..exec.memory import spill_pages
 
-        return spill_pages(self._pages)
+        return spill_pages(self._pages, self._ctx.pool)
 
     def get_output(self):
         if self._finishing and not self._done:
@@ -234,17 +234,19 @@ class HashBuilderOperator(Operator):
             spilled = [p for p in self._pages if isinstance(p, SpilledPage)]
             if spilled and len(spilled) == len(self._pages):
                 # pressure path: concatenate in host RAM, upload once
-                cap = padded_size(sum(p.capacity for p in self._pages))
+                # (host() loads disk-parked pages back into RAM first)
+                hosts = [p.host() for p in self._pages]
+                cap = padded_size(sum(p.capacity for p in hosts))
                 cols, nulls = [], []
                 nch = len(self.input_types)
                 for i in range(nch):
-                    c = np.concatenate([p.cols[i] for p in self._pages])
-                    n = np.concatenate([p.nulls[i] for p in self._pages])
+                    c = np.concatenate([p.cols[i] for p in hosts])
+                    n = np.concatenate([p.nulls[i] for p in hosts])
                     cols.append(jnp.asarray(_np_pad(c, cap)))
                     nulls.append(jnp.asarray(_np_pad(n, cap, fill=True)))
-                v = np.concatenate([p.valid for p in self._pages])
+                v = np.concatenate([p.valid for p in hosts])
                 valid = jnp.asarray(_np_pad(v, cap))
-                dicts = self._unified_dicts(self._pages)
+                dicts = self._unified_dicts(hosts)
             else:
                 pages = [p.to_device() if isinstance(p, SpilledPage) else p
                          for p in self._pages]

@@ -67,16 +67,27 @@ class SourceOperator(Operator):
 
 class TableScanOperator(SourceOperator):
     """Pulls pages from connector page sources and uploads them to device
-    (reference: operator/TableScanOperator.java)."""
+    (reference: operator/TableScanOperator.java).
+
+    Small pages (split tails: a table cut into many splits yields pages
+    far below the connector's page size) COALESCE on host up to
+    ``coalesce_rows`` before the upload, so downstream kernels see one
+    full device batch instead of one launch per fragment (reference:
+    ``operator/MergePages.java`` — the min-page-size rewindow in front
+    of expensive operators)."""
 
     def __init__(self, connector: Connector, columns: Sequence[ColumnHandle],
-                 dynamic_filters: Sequence = ()):
+                 dynamic_filters: Sequence = (),
+                 coalesce_rows: Optional[int] = None):
         self.connector = connector
         self.columns = list(columns)
         # [(channel, DynamicFilter)] — join build-side domains applied to
         # every scanned page as a lane-mask update (reference analog:
         # dynamic-filter TupleDomains pushed into ConnectorPageSource)
         self.dynamic_filters = list(dynamic_filters)
+        self.coalesce_rows = coalesce_rows
+        self._buffer: List[Page] = []
+        self._buffered_rows = 0
         self._splits: List[ConnectorSplit] = []
         self._source = None
         self._no_more_splits = False
@@ -88,6 +99,21 @@ class TableScanOperator(SourceOperator):
     def no_more_splits(self):
         self._no_more_splits = True
 
+    def _upload(self, page: Page) -> DevicePage:
+        dp = DevicePage.from_page(page)
+        for ch, df in self.dynamic_filters:
+            dp = DevicePage(dp.types, dp.cols, dp.nulls,
+                            df.apply(dp.cols[ch], dp.nulls[ch],
+                                     dp.valid),
+                            dp.dictionaries)
+        return dp
+
+    def _flush(self) -> DevicePage:
+        pages, self._buffer = self._buffer, []
+        self._buffered_rows = 0
+        return self._upload(pages[0] if len(pages) == 1
+                            else Page.concat(pages))
+
     def get_output(self) -> Optional[DevicePage]:
         while True:
             if self._source is None:
@@ -96,26 +122,34 @@ class TableScanOperator(SourceOperator):
                     self._source = self.connector.page_source(
                         split, self.columns)
                 elif self._no_more_splits or self._finishing:
+                    if self._buffer:
+                        return self._flush()
                     self._done = True
                     return None
                 else:
-                    return None
+                    return self._flush() if self._buffer else None
             page = self._source.get_next_page()
             if page is None:
                 if self._source.is_finished():
                     self._source.close()
                     self._source = None
                     continue
-                return None
+                # source stalled: don't sit on buffered rows
+                return self._flush() if self._buffer else None
             if page.num_rows == 0:
                 continue
-            dp = DevicePage.from_page(page)
-            for ch, df in self.dynamic_filters:
-                dp = DevicePage(dp.types, dp.cols, dp.nulls,
-                                df.apply(dp.cols[ch], dp.nulls[ch],
-                                         dp.valid),
-                                dp.dictionaries)
-            return dp
+            target = self.coalesce_rows
+            if target and page.num_rows < target:
+                self._buffer.append(page)
+                self._buffered_rows += page.num_rows
+                if self._buffered_rows >= target:
+                    return self._flush()
+                continue
+            if self._buffer:
+                self._buffer.append(page)
+                self._buffered_rows += page.num_rows
+                return self._flush()
+            return self._upload(page)
 
     def is_finished(self) -> bool:
         return self._done
@@ -226,20 +260,31 @@ class LimitOperator(Operator):
 
 
 class ValuesOperator(SourceOperator):
-    """Inline literal rows (reference: operator/ValuesOperator.java)."""
+    """Inline literal rows (reference: operator/ValuesOperator.java).
+    ``coalesce_rows`` applies the scan's small-page coalescing to
+    pre-materialized host pages (the bench's values-fed pipelines)."""
 
-    def __init__(self, pages: Sequence[Page]):
+    def __init__(self, pages: Sequence[Page],
+                 coalesce_rows: Optional[int] = None):
         self._pages = list(pages)
+        self.coalesce_rows = coalesce_rows
         self._done = False
 
     def add_split(self, split):
         raise AssertionError("values has no splits")
 
     def get_output(self) -> Optional[DevicePage]:
-        if self._pages:
+        if not self._pages:
+            self._done = True
+            return None
+        if not self.coalesce_rows:
             return DevicePage.from_page(self._pages.pop(0))
-        self._done = True
-        return None
+        batch, rows = [], 0
+        while self._pages and rows < self.coalesce_rows:
+            batch.append(self._pages.pop(0))
+            rows += batch[-1].num_rows
+        return DevicePage.from_page(batch[0] if len(batch) == 1
+                                    else Page.concat(batch))
 
     def is_finished(self) -> bool:
         return self._done

@@ -144,27 +144,32 @@ class DistributedQueryRunner:
 
         executor = shared_executor()
         streaming = SP.value(self.session, "streaming_execution")
-        if streaming:
-            result_pages = self._execute_streaming(executor, fragments,
-                                                   root, buffers)
-        else:
-            for frag in fragments:
-                ntasks = 1 if frag.partitioning == "single" \
-                    else self.n_workers
-                if frag.output_kind == "output":
-                    collected = self._run_output_fragment(
-                        executor, frag, root, ntasks, buffers)
-                    result_pages = collected
-                else:
-                    buffers[frag.fragment_id] = self._run_fragment(
-                        executor, frag, ntasks, buffers)
+        try:
+            if streaming:
+                result_pages = self._execute_streaming(
+                    executor, fragments, root, buffers)
+            else:
+                for frag in fragments:
+                    ntasks = 1 if frag.partitioning == "single" \
+                        else self.n_workers
+                    if frag.output_kind == "output":
+                        collected = self._run_output_fragment(
+                            executor, frag, root, ntasks, buffers)
+                        result_pages = collected
+                    else:
+                        buffers[frag.fragment_id] = self._run_fragment(
+                            executor, frag, ntasks, buffers)
 
-        rows: List[tuple] = []
-        for p in result_pages:
-            rows.extend(p.to_rows())
+            rows: List[tuple] = []
+            for p in result_pages:
+                rows.extend(p.to_rows())
+            stats = {"memory": self._memory_pool.stats()}
+        except BaseException:
+            # reap spill files + free residue even when the query dies
+            self._memory_pool.close()
+            raise
         names = root.column_names
         types_ = [s.type for s in root.outputs]
-        stats = {"memory": self._memory_pool.stats()}
         if streaming:
             stats["streaming_overlap"] = {
                 fid: buf.overlapped for fid, buf in buffers.items()
@@ -183,6 +188,7 @@ class DistributedQueryRunner:
                 stages=self._stage_stats,
                 wall_ms=(_time.perf_counter() - t0) * 1e3,
                 memory=self._memory_pool.stats())
+        self._memory_pool.close()  # reap spill files, free residue
         return QueryResult(names, types_, rows, stats=stats)
 
     # ----------------------------------------------- streaming mode ----
@@ -338,6 +344,7 @@ class DistributedQueryRunner:
                                     "join_max_expand_lanes"),
             dynamic_filtering=SP.value(
                 self.session, "enable_dynamic_filtering"),
+            scan_coalesce=SP.value(self.session, "scan_coalesce_enabled"),
             **grouping_options(self.session.properties))
         collect = getattr(self, "_collect_stats", False)
         task = TaskStatsTree(t)

@@ -45,7 +45,8 @@ _INTERNAL_CODES = {"GENERIC_INTERNAL_ERROR", "PAGE_TRANSPORT_ERROR",
                    "REMOTE_TASK_ERROR", "NO_NODES_AVAILABLE"}
 _RESOURCE_CODES = {"EXCEEDED_LOCAL_MEMORY_LIMIT",
                    "EXCEEDED_GLOBAL_MEMORY_LIMIT",
-                   "EXCEEDED_MEMORY_LIMIT", "CLUSTER_OUT_OF_MEMORY"}
+                   "EXCEEDED_MEMORY_LIMIT", "CLUSTER_OUT_OF_MEMORY",
+                   "EXCEEDED_NODE_MEMORY", "EXCEEDED_CLUSTER_MEMORY"}
 
 
 def classify_error_code(code: str) -> str:
@@ -211,6 +212,45 @@ class BackoffPolicy:
         return zlib.crc32(query_id.encode())
 
 
+class DecayingFailureStats:
+    """Per-worker failure rate with exponential decay (reference:
+    ``failuredetector/HeartbeatFailureDetector.java``'s DecayCounter):
+    each recorded failure contributes weight 1 that halves every
+    ``half_life_s`` seconds, so a worker that flapped a minute ago
+    outranks one that failed within the last second, and a long-healed
+    worker converges back to 0.  The scheduler sorts task/retry
+    placement by this score so flapping workers shed load without being
+    fenced outright."""
+
+    def __init__(self, half_life_s: float = 60.0):
+        import math
+
+        self._decay = math.log(2.0) / max(half_life_s, 1e-9)
+        self._weight = 0.0
+        self._ts = 0.0
+        self._lock = threading.Lock()
+        self.total = 0              # undecayed lifetime count
+
+    def _decayed_locked(self, now: float) -> float:
+        import math
+
+        if self._weight and now > self._ts:
+            self._weight *= math.exp(-self._decay * (now - self._ts))
+        self._ts = max(self._ts, now)
+        return self._weight
+
+    def record(self, now: Optional[float] = None):
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._weight = self._decayed_locked(now) + 1.0
+            self.total += 1
+
+    def score(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return self._decayed_locked(now)
+
+
 # -- recovery observability ----------------------------------------------
 
 
@@ -230,6 +270,9 @@ class RecoveryStats:
     workers_replaced: int = 0
     speculative_launched: int = 0
     speculative_wins: int = 0
+    #: INSUFFICIENT_RESOURCES retries that re-admitted with a grown
+    #: memory budget / reduced task width (memory-aware escalation)
+    memory_escalations: int = 0
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
 
@@ -248,7 +291,8 @@ class RecoveryStats:
 
     _FIELDS = ("task_attempts", "task_retries", "query_retries",
                "backoff_wall_s", "workers_replaced",
-               "speculative_launched", "speculative_wins")
+               "speculative_launched", "speculative_wins",
+               "memory_escalations")
 
     def merge(self, other: "RecoveryStats"):
         with other._lock:
@@ -271,6 +315,7 @@ class RecoveryStats:
             "workers_replaced": self.workers_replaced,
             "speculative_launched": self.speculative_launched,
             "speculative_wins": self.speculative_wins,
+            "memory_escalations": self.memory_escalations,
         }
 
 

@@ -44,6 +44,20 @@ Round-6 shape: SELF-HEALING fault tolerance —
 - deterministic chaos: ``FaultSchedule`` injects kill-worker /
   drop-connection / delay / fail-after-publish / truncate-spool faults
   by (task-id pattern, occurrence), seeded for exact replay.
+
+Round-7 shape: CLUSTER MEMORY GOVERNANCE —
+- every heartbeat ping piggybacks the worker's NodeMemoryPool snapshot
+  into the coordinator's ``ClusterMemoryManager`` (reference:
+  memory/ClusterMemoryManager.java polling MemoryInfo);
+- a pluggable low-memory killer (``memory_killer_policy``) kills the
+  policy-chosen victim query when nodes report blocked pools, with
+  EXCEEDED_CLUSTER_MEMORY (INSUFFICIENT_RESOURCES);
+- INSUFFICIENT_RESOURCES retries are MEMORY-AWARE: the next attempt
+  re-admits with a budget grown from the observed peak
+  (``MemoryEstimator``; the ``memory_peak`` each task response
+  piggybacks) and a halved concurrent-task width;
+- task/retry placement consults per-worker decaying failure stats
+  (``DecayingFailureStats``) so flapping workers shed load.
 """
 
 from __future__ import annotations
@@ -61,8 +75,8 @@ from typing import Dict, List, Optional, Tuple
 from .. import session_properties as SP
 from .. import types as T
 from ..block import Page
-from ..events import (EventListenerManager, TaskRetryEvent,
-                      WorkerReplacedEvent)
+from ..events import (EventListenerManager, MemoryKillEvent,
+                      TaskRetryEvent, WorkerReplacedEvent)
 from ..exec.serde import PageDeserializer, PageSerializer
 from ..exec.stats import QueryStatsTree
 from ..planner.fragmenter import PlanFragment
@@ -71,9 +85,11 @@ from ..sql import ast
 from ..sql.analyzer import Session
 from ..sql.parser import parse_statement
 from ..types import TrinoError
+from .cluster_memory import ClusterMemoryManager
 from .fault import (EXTERNAL, INSUFFICIENT_RESOURCES, INTERNAL, USER,
-                    BackoffPolicy, Deadline, FaultSchedule, RecoveryStats,
-                    RemoteTaskError, classify_error_code)
+                    BackoffPolicy, Deadline, DecayingFailureStats,
+                    FaultSchedule, RecoveryStats, RemoteTaskError,
+                    classify_error_code)
 from .rpc import call, fetch_pages, recv_msg, send_msg
 
 
@@ -84,6 +100,10 @@ class WorkerHandle:
         self.addr = addr
         self.alive = True
         self.generation = generation   # bumps on replacement
+        #: exponentially-decayed failure score (reference:
+        #: HeartbeatFailureDetector): placement prefers low scores so a
+        #: flapping worker sheds load without being fenced outright
+        self.failure_stats = DecayingFailureStats()
         #: replication cursors: (catalog, schema, table) -> number of
         #: committed pages this worker's replica already holds, so
         #: append-only commits ship only the tail (not O(N^2) re-sends)
@@ -91,6 +111,20 @@ class WorkerHandle:
 
     def rpc(self, request: dict, timeout: float = 600.0) -> dict:
         return call(self.addr, request, timeout=timeout)
+
+
+#: a worker whose decayed failure score reaches this is skipped for
+#: placement while any healthier candidate exists: one fresh failure
+#: (score 1.0) keeps a worker avoided for a full half-life
+_FLAPPING_SCORE = 0.5
+
+
+def prefer_healthy(workers: List[WorkerHandle]) -> List[WorkerHandle]:
+    """Placement filter over live workers: drop the ones currently
+    scored as flapping, unless that would leave nobody."""
+    healthy = [w for w in workers
+               if w.failure_stats.score() < _FLAPPING_SCORE]
+    return healthy or workers
 
 
 class _QueryCtx:
@@ -109,6 +143,11 @@ class _QueryCtx:
                                      "speculative_execution_enabled")
         self.spec_multiplier = SP.value(session, "speculation_multiplier")
         self.spec_min_s = SP.value(session, "speculation_min_seconds")
+        #: memory-aware retry state: per-attempt session overrides
+        #: (grown query_max_memory_bytes) and reduced task width, set by
+        #: the escalation path after an INSUFFICIENT_RESOURCES failure
+        self.session_overrides: Dict[str, object] = {}
+        self.task_width: Optional[int] = None
 
     def timeout(self, base: Optional[float] = None) -> float:
         """RPC timeout capped by the query deadline (raises
@@ -203,6 +242,12 @@ class ProcessQueryRunner:
         self.recovery_total = RecoveryStats()
         self.event_manager = EventListenerManager(
             list(event_listeners or ()))
+        #: coordinator-side memory governance: aggregates pool snapshots
+        #: piggybacked on heartbeats, enforces query_max_total_memory,
+        #: and runs the low-memory killer (ref: ClusterMemoryManager)
+        self.cluster_memory = ClusterMemoryManager(
+            SP.value(self.session, "memory_killer_policy"),
+            SP.value(self.session, "query_max_total_memory"))
         self.worker_replacement = worker_replacement
         self.heartbeat_interval = heartbeat_interval
         self._heal_lock = threading.Lock()
@@ -377,14 +422,26 @@ class ProcessQueryRunner:
     def heartbeat(self) -> List[bool]:
         """Ping every worker (reference: HeartbeatFailureDetector.ping);
         marks dead workers so scheduling skips them. Pure probe — use
-        ``heal()`` to also replace the dead."""
+        ``heal()`` to also replace the dead. Each ping's response
+        piggybacks the worker's memory-pool snapshot into the
+        ClusterMemoryManager (no extra RPC)."""
         ok = []
-        for w in self.workers:
+        for i, w in enumerate(self.workers):
+            memory = None
             try:
-                alive = bool(w.rpc({"op": "ping"}, timeout=10).get("ok"))
+                resp = w.rpc({"op": "ping"}, timeout=10)
+                alive = bool(resp.get("ok"))
+                memory = resp.get("memory")
             except OSError:
                 alive = False
+            was_alive = w.alive
             w.alive = w.alive and alive and w.proc.poll() is None
+            if was_alive and not w.alive:
+                w.failure_stats.record()
+            if w.alive:
+                self.cluster_memory.update(i, memory)
+            else:
+                self.cluster_memory.forget_worker(i)
             ok.append(w.alive)
         return ok
 
@@ -446,14 +503,30 @@ class ProcessQueryRunner:
             index, old.proc.pid, new.proc.pid, reason, time.time()))
 
     def _monitor_loop(self):
-        """Background failure detector: the configurable-interval
-        heartbeat that makes replacement autonomous rather than only
+        """Background failure detector + memory governor: the
+        configurable-interval heartbeat that makes worker replacement
+        and low-memory kills autonomous rather than only
         retry-path-triggered."""
         while not self._closed.wait(self.heartbeat_interval):
             try:
                 self.heal(reason="heartbeat")
+                self.run_memory_governance()
             except Exception:
                 traceback.print_exc()
+
+    def run_memory_governance(self) -> Optional[str]:
+        """One governance tick over the latest heartbeat snapshots:
+        enforce query_max_total_memory and — when nodes report blocked
+        pools — let the killer policy pick a victim. The victim's
+        execution observes the kill as EXCEEDED_CLUSTER_MEMORY
+        (INSUFFICIENT_RESOURCES), so its retry re-admits escalated."""
+        victim = self.cluster_memory.maybe_kill()
+        if victim is not None:
+            totals = self.cluster_memory.query_totals()
+            self.event_manager.fire_memory_kill(MemoryKillEvent(
+                victim, self.cluster_memory.last_kill_source,
+                totals.get(victim, 0), time.time()))
+        return victim
 
     def inject_task_failure(self, task_prefix: str, times: int = 1):
         """Arm failure injection: the next `times` tasks whose id starts
@@ -472,6 +545,39 @@ class ProcessQueryRunner:
         self.event_manager.fire_task_retry(TaskRetryEvent(
             task_id, error_type, attempt, speculative, query_level,
             time.time()))
+
+    def _escalate_memory(self, ctx: _QueryCtx, failed_qid: str):
+        """Grow the next attempt's memory budget from the failed
+        attempt's OBSERVED peak (heartbeat- or response-reported) and
+        halve its concurrent-task width: re-admission under pressure
+        must change the resource shape, not just replay."""
+        est = self.cluster_memory.estimator
+        cur = ctx.session_overrides.get(
+            "query_max_memory_bytes",
+            SP.value(self.session, "query_max_memory_bytes"))
+        floor = SP.value(self.session, "retry_initial_memory")
+        new = est.next_budget(failed_qid, int(cur), int(floor))
+        if new > cur:
+            ctx.session_overrides["query_max_memory_bytes"] = new
+        width = ctx.task_width if ctx.task_width is not None \
+            else self.n_workers
+        ctx.task_width = max(1, width // 2)
+        ctx.recovery.incr("memory_escalations")
+
+    def _session_for(self, ctx: _QueryCtx) -> dict:
+        """The session properties shipped with this attempt's tasks:
+        the configured session plus the escalation overrides."""
+        props = dict(self.session.properties)
+        props.update(ctx.session_overrides)
+        return props
+
+    def _record_peak(self, task_id: str, resp: dict):
+        """Fold a task response's piggybacked pool peak into the
+        estimator (covers short-lived pools no heartbeat sampled)."""
+        peak = resp.get("memory_peak") if isinstance(resp, dict) else None
+        if peak:
+            self.cluster_memory.estimator.record_peak(
+                task_id.split(".", 1)[0], peak)
 
     def _backoff_sleep(self, ctx: _QueryCtx, attempt: int):
         """Exponential backoff with deterministic jitter between retry
@@ -514,6 +620,8 @@ class ProcessQueryRunner:
         res = self._execute_with_retry(stmt)
         tree = QueryStatsTree(
             wall_ms=(time.perf_counter() - t0) * 1e3,
+            memory=(res.stats or {}).get("memory"),
+            cluster_memory=(res.stats or {}).get("cluster_memory"),
             recovery=(res.stats or {}).get("recovery"))
         lines = tree.render()
         lines.append(f"Output: {len(res.rows)} rows")
@@ -569,6 +677,12 @@ class ProcessQueryRunner:
                     getattr(res, "_query_tasks", []), qid)
                 res.stats = dict(res.stats or {})
                 res.stats["recovery"] = ctx.recovery.to_dict()
+                res.stats["cluster_memory"] = \
+                    self.cluster_memory.cluster_stats()
+                peak = self.cluster_memory.estimator.peak_for(qid)
+                if peak:
+                    res.stats["memory"] = dict(
+                        res.stats.get("memory") or {}, peak_bytes=peak)
                 return res
             except _WorkerLost as e:
                 self._discard_staged(qid)
@@ -605,6 +719,11 @@ class ProcessQueryRunner:
                         or attempt == attempts - 1:
                     raise
                 last_error = e
+                # memory-aware escalation: the next attempt re-admits
+                # with a budget grown from the observed peak and a
+                # reduced concurrent-task width — not the identical
+                # doomed plan (reference: PartitionMemoryEstimator)
+                self._escalate_memory(ctx, qid)
                 ctx.recovery.record_retry(INSUFFICIENT_RESOURCES,
                                           query_level=True)
                 self._fire_retry(qid, INSUFFICIENT_RESOURCES, attempt,
@@ -708,22 +827,26 @@ class ProcessQueryRunner:
                         live: List[WorkerHandle], upstream: dict,
                         query_tasks: List, bound: int,
                         ctx: _QueryCtx) -> dict:
-        ntasks = 1 if frag.partitioning == "single" else self.n_workers
+        self.cluster_memory.check_killed(qid)
+        width = ctx.task_width if ctx.task_width is not None \
+            else self.n_workers
+        ntasks = 1 if frag.partitioning == "single" else width
+        placeable = prefer_healthy(live)
         results = []
         for t in range(ntasks):
             task_id = f"{qid}.f{frag.fragment_id}.t{t}.s"
             self.task_launches.append(task_id)
             ctx.recovery.incr("task_attempts")
-            worker = live[t % len(live)]
+            worker = placeable[t % len(placeable)]
             req = {
                 "op": "run_task", "task_id": task_id,
                 "fragment": frag, "task_index": t,
                 "task_count": ntasks,
-                "n_partitions": self.n_workers,
+                "n_partitions": width,
                 "output_kind": frag.output_kind,
                 "upstream": upstream,
                 "desired_splits": self.desired_splits,
-                "session": dict(self.session.properties),
+                "session": self._session_for(ctx),
                 "streaming": True, "buffer_bound": bound,
                 "coordinator": self.service.addr,
                 "remote_write_catalogs": sorted(self._replicated),
@@ -736,6 +859,7 @@ class ProcessQueryRunner:
                 resp = worker.rpc(req, timeout=ctx.timeout())
             except OSError:
                 worker.alive = False
+                worker.failure_stats.record()
                 raise _WorkerLost(f"worker {worker.addr} unreachable")
             if not resp.get("ok"):
                 raise self._task_error(resp, task_id)
@@ -753,6 +877,12 @@ class ProcessQueryRunner:
         pointless task retry; everything else is task-retryable with
         its type."""
         if err.error_type == USER:
+            return TrinoError(str(err), err.error_code)
+        if err.error_type == INSUFFICIENT_RESOURCES:
+            # a memory failure re-fails identically on any worker at
+            # the same budget: skip task-level retry and go straight to
+            # the query-level memory-aware escalation (grown budget,
+            # reduced width)
             return TrinoError(str(err), err.error_code)
         if err.connection_lost:
             return _WorkerLost(str(err), err.error_type)
@@ -884,7 +1014,9 @@ class ProcessQueryRunner:
         on other workers (taxonomy-gated), speculatively re-dispatch
         stragglers when outputs are durable, enforce the query deadline
         while waiting."""
-        ntasks = 1 if frag.partitioning == "single" else self.n_workers
+        width = ctx.task_width if ctx.task_width is not None \
+            else self.n_workers
+        ntasks = 1 if frag.partitioning == "single" else width
         upstream = {fid: loc for fid, loc in locations.items()}
         spool_dir = None
         if spool_mgr is not None:
@@ -905,11 +1037,11 @@ class ProcessQueryRunner:
                 "op": "run_task", "task_id": attempt_id,
                 "fragment": frag, "task_index": t,
                 "task_count": ntasks,
-                "n_partitions": self.n_workers,
+                "n_partitions": width,
                 "output_kind": frag.output_kind,
                 "upstream": upstream,
                 "desired_splits": self.desired_splits,
-                "session": dict(self.session.properties),
+                "session": self._session_for(ctx),
                 "coordinator": self.service.addr,
                 "remote_write_catalogs": sorted(self._replicated),
                 "spool_dir": spool_dir,
@@ -927,7 +1059,9 @@ class ProcessQueryRunner:
                 resp = worker.rpc(req, timeout=ctx.timeout())
             except OSError:
                 worker.alive = False
+                worker.failure_stats.record()
                 return "lost-worker", None
+            self._record_peak(attempt_id, resp)
             if resp.get("ok"):
                 with reg_lock:
                     if results[t] is None and not closed:
@@ -960,6 +1094,9 @@ class ProcessQueryRunner:
                     if not candidates:
                         errors[t] = ("no live workers", EXTERNAL)
                         return
+                    # flapping workers (decayed failure score) shed
+                    # load: place on the healthy subset when one exists
+                    candidates = prefer_healthy(candidates)
                     worker = candidates[(t + retry) % len(candidates)]
                     tried.append(worker)
                     attempt_id = f"{task_id}.r{retry}"
@@ -1059,6 +1196,10 @@ class ProcessQueryRunner:
         while not all(ev.is_set() for ev in done):
             try:
                 ctx.deadline.check()
+                # a low-memory kill lands here: the supervised stage
+                # aborts with EXCEEDED_CLUSTER_MEMORY and the retry
+                # loop re-admits with an escalated budget
+                self.cluster_memory.check_killed(qid)
             except TrinoError as e:
                 fatal.append(e)
                 # unblock run_one threads waiting on nothing; attempts

@@ -27,8 +27,9 @@ Protocol (rpc.py framing; one request per connection):
                    streaming?, buffer_bound?, coordinator?,
                    remote_write_catalogs?, fault? (FaultSchedule
                    directive; legacy inject_failure => kind=error)}
-                          -> {ok, rows?} | {error, error_type,
-                              error_code, remote_traceback}
+                          -> {ok, rows?, memory_peak?} | {error,
+                              error_type, error_code, remote_traceback,
+                              memory_peak?}
   get_results     {task_id, partition}              -> header + frames
   get_page_stream {task_id, partition, consumer_id, wait}
                                                     -> header + frames
@@ -37,8 +38,14 @@ Protocol (rpc.py framing; one request per connection):
   sync_table      {catalog, schema, table, columns, frames} -> {ok}
   drop_table      {catalog, schema, table}          -> {ok}
   release_task    {task_id}                         -> {ok}
-  ping            {}                                -> {ok, tasks}
+  ping            {}                 -> {ok, tasks, memory} (the node
+                   memory-pool snapshot piggybacks on the heartbeat)
   shutdown        {}                                -> {ok} (then exits)
+
+Memory governance (round 7): ``configure`` builds the worker-wide
+NodeMemoryPool (``node_max_memory_bytes``); each query's tasks share a
+refcounted per-query child pool charged by the operators' memory
+contexts, with host-RAM and disk spill tiers below it.
 """
 
 from __future__ import annotations
@@ -76,6 +83,10 @@ class WorkerServer:
         self.connectors = {}
         self.properties: dict = {}
         self._lock = threading.Lock()
+        #: worker-wide pool all queries charge (built at configure);
+        #: per-query children are refcounted by their running tasks
+        self.node_pool = None
+        self._pool_refs: Dict[str, int] = {}
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -105,10 +116,16 @@ class WorkerServer:
     def dispatch(self, sock, req: dict):
         op = req.get("op")
         if op == "configure":
+            from .. import session_properties as SP
             from ..connectors.catalog import create_catalogs
+            from ..exec.memory import NodeMemoryPool
 
             self.connectors = create_catalogs(req["catalogs"])
             self.properties = dict(req.get("properties", {}))
+            self.node_pool = NodeMemoryPool(
+                SP.prop_value(self.properties, "node_max_memory_bytes"),
+                host_spill_limit=SP.prop_value(
+                    self.properties, "spill_host_memory_bytes"))
             send_msg(sock, {"ok": True})
         elif op == "run_task":
             send_msg(sock, self.run_task(req))
@@ -138,8 +155,14 @@ class WorkerServer:
                 self.tasks.pop(req["task_id"], None)
             send_msg(sock, {"ok": True})
         elif op == "ping":
+            # the heartbeat PIGGYBACKS the node pool snapshot: the
+            # coordinator's ClusterMemoryManager sees every worker's
+            # per-query reservations without an extra RPC (reference:
+            # MemoryInfo riding the ServerInfo heartbeat)
             send_msg(sock, {"ok": True, "pid": os.getpid(),
-                            "tasks": len(self.tasks)})
+                            "tasks": len(self.tasks),
+                            "memory": self.node_pool.snapshot()
+                            if self.node_pool is not None else None})
         elif op == "shutdown":
             send_msg(sock, {"ok": True})
             threading.Thread(target=self.server.shutdown,
@@ -210,6 +233,38 @@ class WorkerServer:
 
     # ------------------------------------------------------------------
 
+    def _acquire_query_pool(self, task_id: str, session: dict):
+        """The per-query child of the node pool, refcounted by running
+        tasks: concurrent tasks of one query share its QueryMemoryPool,
+        and the last release closes it (freeing spill files)."""
+        if self.node_pool is None:
+            return None
+        from .. import session_properties as SP
+
+        qid = task_id.split(".", 1)[0]
+        with self._lock:
+            self._pool_refs[qid] = self._pool_refs.get(qid, 0) + 1
+        return self.node_pool.create_query_pool(
+            qid,
+            SP.prop_value(session, "query_max_memory_bytes"),
+            SP.prop_value(session, "spill_enabled"),
+            SP.prop_value(session, "spill_to_disk_enabled"))
+
+    def _release_query_pool(self, task_id: str):
+        if self.node_pool is None:
+            return
+        qid = task_id.split(".", 1)[0]
+        # pop + release under ONE lock hold: a sibling task acquiring
+        # between them would get a pool we are about to close (freed
+        # contexts, reaped spill dir)
+        with self._lock:
+            refs = self._pool_refs.get(qid, 0) - 1
+            if refs > 0:
+                self._pool_refs[qid] = refs
+                return
+            self._pool_refs.pop(qid, None)
+            self.node_pool.release_query(qid)
+
     def run_task(self, req: dict) -> dict:
         from ..ops.output import OutputBuffer
         from .fault import serialize_failure
@@ -223,18 +278,28 @@ class WorkerServer:
         with self._lock:
             self.tasks[task_id] = state
         if not req.get("streaming"):
+            pool = self._acquire_query_pool(task_id,
+                                            req.get("session", {}))
             try:
                 self._apply_start_fault(fault, task_id)
                 state.rows = self._execute_fragment(req, state,
-                                                    fault=fault)
+                                                    fault=fault,
+                                                    memory_pool=pool)
                 state.status = "finished"
-                return {"ok": True, "rows": state.rows}
+                # the attempt's observed peak rides the response, so the
+                # coordinator's MemoryEstimator can size a retry even
+                # when no heartbeat sampled this short-lived pool
+                return {"ok": True, "rows": state.rows,
+                        "memory_peak": pool.peak_bytes if pool else 0}
             except Exception as e:
                 state.status = "failed"
                 state.failure = serialize_failure(e)
                 state.error = state.failure["error"]
                 traceback.print_exc()
-                return dict(state.failure, task_id=task_id)
+                return dict(state.failure, task_id=task_id,
+                            memory_peak=pool.peak_bytes if pool else 0)
+            finally:
+                self._release_query_pool(task_id)
         # streaming: the buffer must exist before we acknowledge, so
         # consumers can start pulling immediately
         frag = req["fragment"]
@@ -286,11 +351,14 @@ class WorkerServer:
         from .fault import serialize_failure
         from .remote_exchange import ExchangeConnectionLost
 
+        pool = self._acquire_query_pool(req["task_id"],
+                                        req.get("session", {}))
         try:
             self._apply_start_fault(fault, req["task_id"])
             state.rows = self._execute_fragment(req, state,
                                                 streaming=True,
-                                                fault=fault)
+                                                fault=fault,
+                                                memory_pool=pool)
             state.status = "finished"
             state.buffer.set_no_more_pages()
         except ExchangeConnectionLost as e:
@@ -308,6 +376,7 @@ class WorkerServer:
                 traceback.print_exc()
             state.buffer.abort()
         finally:
+            self._release_query_pool(req["task_id"])
             for ch in state.channels:
                 ch.close()
 
@@ -349,7 +418,8 @@ class WorkerServer:
 
     def _execute_fragment(self, req: dict, state: _TaskState,
                           streaming: bool = False,
-                          fault: Optional[dict] = None) -> int:
+                          fault: Optional[dict] = None,
+                          memory_pool=None) -> int:
         from ..exec.driver import Driver
         from ..exec.local_planner import (LocalExecutionPlanner,
                                           grouping_options,
@@ -427,10 +497,12 @@ class WorkerServer:
             metadata, req.get("desired_splits", 8),
             task_id=task_index, task_count=req["task_count"],
             exchange_reader=exchange_reader,
+            memory_pool=memory_pool,
             join_max_lanes=session_props.get("join_max_expand_lanes"),
             dynamic_filtering=session_props.get(
                 "enable_dynamic_filtering", True),
             page_sink_factory=self._sink_factory(req),
+            scan_coalesce=session_props.get("scan_coalesce_enabled", True),
             **grouping_options(session_props))
 
         ops, layout, types_ = planner.visit(frag.root)

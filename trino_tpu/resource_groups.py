@@ -34,6 +34,13 @@ class ResourceGroupSpec:
     max_concurrency: int = 10
     max_queued: int = 100
     user_pattern: str = ".*"        # selector: route by user
+    #: memory-aware admission (reference: InternalResourceGroup's
+    #: softMemoryLimit): while the group's reserved memory sits above
+    #: the SOFT limit no new query is admitted (running ones finish);
+    #: a query whose own budget would push reserved past the HARD
+    #: limit waits for memory, not just for a concurrency slot
+    soft_memory_limit_bytes: Optional[int] = None
+    hard_memory_limit_bytes: Optional[int] = None
     subgroups: List["ResourceGroupSpec"] = field(default_factory=list)
 
 
@@ -46,6 +53,7 @@ class ResourceGroup:
             else f"{parent.name}.{spec.name}"
         self.running = 0
         self.queued = 0
+        self.memory_reserved = 0    # sum of admitted queries' budgets
         # ONE condition per tree: a release in any subgroup may free
         # shared ancestor capacity a SIBLING's waiter is blocked on, and
         # ancestor counters must mutate under one lock
@@ -61,41 +69,69 @@ class ResourceGroup:
             g = g.parent
         return out
 
-    def _can_run_locked(self) -> bool:
-        return all(g.running < g.spec.max_concurrency
-                   for g in self._chain())
+    def _can_run_locked(self, memory_bytes: int = 0) -> bool:
+        for g in self._chain():
+            if g.running >= g.spec.max_concurrency:
+                return False
+            soft = g.spec.soft_memory_limit_bytes
+            if soft is not None and g.memory_reserved > soft:
+                return False    # soft limit: no NEW admissions
+            hard = g.spec.hard_memory_limit_bytes
+            if hard is not None and \
+                    g.memory_reserved + memory_bytes > hard:
+                return False    # hard limit: this query must wait
+        return True
 
-    def acquire(self, timeout: Optional[float] = None):
-        """Block until a running slot frees up along the whole ancestor
-        chain; reject immediately when this group's queue is full."""
+    def acquire(self, timeout: Optional[float] = None,
+                memory_bytes: int = 0):
+        """Block until a running slot AND the memory headroom free up
+        along the whole ancestor chain; reject immediately when this
+        group's queue is full.  ``memory_bytes`` is the query's
+        admission charge (its memory budget) — admission is memory-
+        aware, not just slot-counting."""
+        # an unsatisfiable request must reject loudly, never queue: no
+        # amount of releases lets a budget above the hard limit fit
+        for g in self._chain():
+            hard = g.spec.hard_memory_limit_bytes
+            if hard is not None and memory_bytes > hard:
+                raise TrinoError(
+                    f"query memory budget {memory_bytes} bytes exceeds "
+                    f"resource group '{g.name}' hard memory limit "
+                    f"{hard}; lower query_max_memory_bytes",
+                    "QUERY_REJECTED")
         with self._cond:
-            if not self._can_run_locked():
+            if not self._can_run_locked(memory_bytes):
                 if self.queued >= self.spec.max_queued:
                     raise QueryQueueFullError(self.name)
                 self.queued += 1
                 try:
-                    ok = self._cond.wait_for(self._can_run_locked,
-                                             timeout=timeout)
+                    ok = self._cond.wait_for(
+                        lambda: self._can_run_locked(memory_bytes),
+                        timeout=timeout)
                     if not ok:
                         raise QueryQueueFullError(self.name)
                 finally:
                     self.queued -= 1
             for g in self._chain():
                 g.running += 1
+                g.memory_reserved += memory_bytes
 
-    def release(self):
+    def release(self, memory_bytes: int = 0):
         with self._cond:
             for g in self._chain():
                 g.running -= 1
+                g.memory_reserved = max(
+                    0, g.memory_reserved - memory_bytes)
             self._cond.notify_all()
 
     @contextmanager
-    def run(self, timeout: Optional[float] = None):
-        self.acquire(timeout)
+    def run(self, timeout: Optional[float] = None,
+            memory_bytes: int = 0):
+        self.acquire(timeout, memory_bytes)
         try:
             yield self
         finally:
-            self.release()
+            self.release(memory_bytes)
 
 
 class ResourceGroupManager:
@@ -108,11 +144,16 @@ class ResourceGroupManager:
     @classmethod
     def from_config(cls, doc: dict) -> "ResourceGroupManager":
         def spec(d: dict) -> ResourceGroupSpec:
+            def limit(key):
+                return int(d[key]) if key in d else None
+
             return ResourceGroupSpec(
                 name=d["name"],
                 max_concurrency=int(d.get("max_concurrency", 10)),
                 max_queued=int(d.get("max_queued", 100)),
                 user_pattern=d.get("user", ".*"),
+                soft_memory_limit_bytes=limit("soft_memory_limit_bytes"),
+                hard_memory_limit_bytes=limit("hard_memory_limit_bytes"),
                 subgroups=[spec(s) for s in d.get("subgroups", [])])
 
         return cls([spec(d) for d in doc.get("groups",

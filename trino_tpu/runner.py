@@ -110,8 +110,13 @@ class LocalQueryRunner:
             monitor.created()
         try:
             if self.resource_groups is not None:
+                from . import session_properties as SP
+
                 group = self.resource_groups.select(self.session.user)
-                with group.run():
+                # memory-aware admission: the query's budget is its
+                # charge against the group's soft/hard memory limits
+                with group.run(memory_bytes=SP.value(
+                        self.session, "query_max_memory_bytes")):
                     res = self._execute_sql(sql)
             else:
                 res = self._execute_sql(sql)
@@ -199,12 +204,17 @@ class LocalQueryRunner:
         root = self.plan_statement(stmt)
         self._check_table_access(stmt, root)
         local = self._make_local_planner()
-        plan = local.plan(root)
-        pages = plan.execute()
-        rows: List[tuple] = []
-        for p in pages:
-            rows.extend(p.to_rows())
-        stats = {"memory": local.memory_pool.stats()}
+        try:
+            plan = local.plan(root)
+            pages = plan.execute()
+            rows: List[tuple] = []
+            for p in pages:
+                rows.extend(p.to_rows())
+            stats = {"memory": local.memory_pool.stats()}
+        finally:
+            # reap spill files + free residue on success AND failure —
+            # a failed spilling query must not leak its spill directory
+            local.memory_pool.close()
         if local.dynamic_filters:
             stats["dynamic_filters"] = [df.stats()
                                         for df in local.dynamic_filters]
@@ -236,6 +246,7 @@ class LocalQueryRunner:
             join_max_lanes=self._join_lanes(),
             dynamic_filtering=SP.value(self.session,
                                        "enable_dynamic_filtering"),
+            scan_coalesce=SP.value(self.session, "scan_coalesce_enabled"),
             **grouping_options(self.session.properties))
 
     def _explain_analyze(self, stmt: ast.Statement) -> QueryResult:
@@ -248,18 +259,24 @@ class LocalQueryRunner:
         self._check_table_access(stmt, root)  # ANALYZE executes the query
         local = self._make_local_planner()
         pool = local.memory_pool
-        plan = local.plan(root)
-        t0 = _time.perf_counter()
-        pages = plan.execute(collect_stats=True)
-        wall = _time.perf_counter() - t0
+        try:
+            plan = local.plan(root)
+            t0 = _time.perf_counter()
+            pages = plan.execute(collect_stats=True)
+            wall = _time.perf_counter() - t0
+            m = pool.stats()
+        finally:
+            pool.close()
         out_rows = sum(p.num_rows for p in pages)
         lines = plan_tree_str(root).splitlines()
         lines.append("")
         lines.append(f"Query: {wall * 1e3:.1f}ms, {out_rows} rows")
-        m = pool.stats()
         lines.append(
             f"Memory: peak {m['peak_bytes']} bytes, "
-            f"{m['spill_events']} spills ({m['spilled_bytes']} bytes)")
+            f"{m['spill_events']} spills ({m['spilled_bytes']} bytes)"
+            + (f", disk {m['disk_spill_events']} files "
+               f"({m['disk_spilled_bytes']} bytes)"
+               if m.get("disk_spill_events") is not None else ""))
         for i, d in enumerate(plan.drivers):
             lines.append(f"Pipeline {i}:")
             for st in d.stats:

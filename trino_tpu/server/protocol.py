@@ -205,6 +205,15 @@ class ProtocolServer:
         else:
             if res.stats:
                 doc["stats"]["memory"] = res.stats.get("memory")
+                # cluster memory governance + self-healing counters ride
+                # the final page's stats (reference: QueryStats served
+                # on /v1/query/{id} — here folded into the statement
+                # protocol's stats block)
+                if "cluster_memory" in res.stats:
+                    doc["stats"]["clusterMemory"] = \
+                        res.stats["cluster_memory"]
+                if "recovery" in res.stats:
+                    doc["stats"]["recovery"] = res.stats["recovery"]
                 if "dynamic_filters" in res.stats:
                     doc["stats"]["dynamicFilters"] = \
                         res.stats["dynamic_filters"]

@@ -61,6 +61,53 @@ register(SessionProperty(
     "spill_enabled", "boolean", False,
     "Spill aggregation/join state to host on memory pressure"))
 register(SessionProperty(
+    "spill_to_disk_enabled", "boolean", False,
+    "Second spill tier below host RAM: when the host spill ledger "
+    "exceeds spill_host_memory_bytes, the largest parked pages demote "
+    "to per-query CRC-framed spill files (reference: "
+    "FileSingleStreamSpiller) and reload transparently"))
+register(SessionProperty(
+    "spill_host_memory_bytes", "integer", 4 << 30,
+    "Host-RAM budget for spilled state before the disk tier takes the "
+    "overflow (0 = spill straight to disk)",
+    lambda v: v >= 0))
+register(SessionProperty(
+    "node_max_memory_bytes", "integer", 16 << 30,
+    "Worker-wide memory pool shared by ALL concurrent queries on a "
+    "node; over-budget reservations revoke across queries largest-"
+    "first, then fail with EXCEEDED_NODE_MEMORY (reference: the "
+    "per-node general MemoryPool)",
+    lambda v: v > 0))
+register(SessionProperty(
+    "query_max_total_memory", "integer", 0,
+    "Cluster-wide cap on one query's total reservation summed over all "
+    "workers; the ClusterMemoryManager kills a query crossing it with "
+    "EXCEEDED_CLUSTER_MEMORY (0 = unlimited; reference: "
+    "query.max-total-memory)",
+    lambda v: v >= 0))
+register(SessionProperty(
+    "memory_killer_policy", "varchar", "total-reservation-on-blocked-nodes",
+    "Low-memory-killer victim policy when workers report blocked "
+    "memory pools: total-reservation-on-blocked-nodes (default) | "
+    "total-reservation | none (reference: "
+    "TotalReservationOnBlockedNodesLowMemoryKiller)",
+    lambda v: v in ("total-reservation-on-blocked-nodes",
+                    "total-reservation", "none"),
+    normalize=str.lower))
+register(SessionProperty(
+    "retry_initial_memory", "integer", 1 << 30,
+    "Floor for the re-admitted per-query memory budget when an "
+    "INSUFFICIENT_RESOURCES failure retries: the next attempt runs "
+    "with max(this, growth x observed peak) and reduced task width "
+    "(reference: PartitionMemoryEstimator escalation)",
+    lambda v: v > 0))
+register(SessionProperty(
+    "scan_coalesce_enabled", "boolean", True,
+    "Coalesce small scan pages (split tails) on host up to the "
+    "connector's page size before device upload: one kernel launch "
+    "per full page instead of one per fragmentized page (reference: "
+    "MergePages)"))
+register(SessionProperty(
     "enable_dynamic_filtering", "boolean", True,
     "Prune probe-side scans with join build-side key domains "
     "(min/max + small value sets)"))
@@ -220,6 +267,13 @@ def set_property(properties: Dict[str, Any], name: str, raw):
 def value(session, name: str):
     prop = REGISTRY[name]
     return session.properties.get(name, prop.default)
+
+
+def prop_value(properties: Dict[str, Any], name: str):
+    """``value`` over a bare properties dict (worker-side: the session
+    rides RPC requests as a plain mapping) — one default-resolution
+    path, not a per-call-site closure."""
+    return properties.get(name, REGISTRY[name].default)
 
 
 def listing(session) -> List[tuple]:
