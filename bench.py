@@ -40,7 +40,11 @@ of that query; the first pays them). Internal: BENCH_ROLE=measure
 BENCH_PLATFORM=cpu|default; BENCH_ROLE=chaos (fault-injection smoke,
 CHAOS_RESULT line); BENCH_ROLE=memory (memory-governance smoke:
 forced host+disk spill oracle + killer determinism, MEMORY_RESULT
-line with spill/kill counters, rc=5 on mismatch).
+line with spill/kill counters, rc=5 on mismatch); BENCH_ROLE=skew
+(adversarial-skew smoke: zipf-keyed device exchange with
+hot-partition splitting vs the unsplit oracle + scaled-writer CTAS
+vs the unscaled oracle, SKEW_RESULT line with split/rebalance
+counters and rows/s, rc=6 on mismatch).
 """
 
 import json
@@ -256,6 +260,122 @@ def _memory_smoke() -> dict:
     print("MEMORY_RESULT " + json.dumps(out), flush=True)
     if not out["ok"]:
         raise SystemExit(5)
+    return out
+
+
+def _skew_smoke() -> dict:
+    """BENCH_ROLE=skew: adversarial-skew smoke for the exchange layer.
+
+    Part A — the device collective: a zipf-distributed join key (one
+    dominant partition) exchanged with hot-partition splitting vs the
+    unsplit oracle (threshold=1.0); per-partition row multisets must be
+    identical, the hot partition must spread over >= 2 receiver lanes
+    with zero overflow retries, and the split run's rows/s rides along.
+    Part B — the write path: CTAS over the same zipf keys with
+    scale_writers_enabled vs the unscaled plan; written rows must
+    match and the rebalancer must have re-assigned at least once.
+    rc=6 on any mismatch so skew regressions fail loudly in CI."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # splitting needs >= 2 receiver devices; mirror tests/conftest
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import numpy as np
+
+    from trino_tpu import types as T
+    from trino_tpu.block import DevicePage, Page
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.parallel.device_exchange import (DeviceExchange,
+                                                    SIZING_HISTORY)
+    from trino_tpu.parallel.distributed import DistributedQueryRunner
+    from trino_tpu.parallel.rebalancer import UniformPartitionRebalancer
+    from trino_tpu.sql.analyzer import Session
+    import jax
+
+    t0 = time.time()
+    rng = np.random.default_rng(17)
+    n_tasks, rows_per_task = 4, 20_000
+    # zipf(2.0): the rank-1 key alone carries ~60% of rows — one hot
+    # partition, plus a long tail exercising the cold lanes
+    zkeys = rng.zipf(2.0, size=n_tasks * rows_per_task) % 4096
+    zvals = rng.integers(0, 1000, n_tasks * rows_per_task)
+
+    def exchange(threshold):
+        SIZING_HISTORY.reset()
+        ex = DeviceExchange(n_tasks, jax.devices(), sizing="exact",
+                            hot_split_threshold=threshold)
+        ex.configure([T.BIGINT, T.BIGINT], [0])
+        for t in range(n_tasks):
+            lo, hi = t * rows_per_task, (t + 1) * rows_per_task
+            ex.add_page(t, DevicePage.from_page(Page.from_pylists(
+                [T.BIGINT, T.BIGINT],
+                [zkeys[lo:hi].tolist(), zvals[lo:hi].tolist()])))
+        ex.set_no_more_pages()
+        start = time.perf_counter()
+        parts = []
+        for p in range(n_tasks):
+            rows = []
+            for pg in ex.pages(p):
+                v = np.asarray(pg.valid)
+                rows.extend(zip(np.asarray(pg.cols[0])[v].tolist(),
+                                np.asarray(pg.cols[1])[v].tolist()))
+            parts.append(sorted(rows))
+        wall = time.perf_counter() - start
+        return ex, parts, wall
+
+    ex_split, parts_split, wall_split = exchange(0.5)
+    ex_plain, parts_plain, _ = exchange(1.0)
+    s = ex_split.stats
+    exchange_ok = (
+        parts_split == parts_plain
+        and s["splits"] >= 1
+        and max(s["hot_spread"].values(), default=0) >= 2
+        and ex_split.a2a_retries == 0
+        and s["lane_skew_ratio"] < ex_plain.stats["lane_skew_ratio"])
+
+    def write(scale):
+        SIZING_HISTORY.reset()
+        sess = Session(catalog="mem", schema="default")
+        sess.properties["scale_writers_enabled"] = scale
+        r = DistributedQueryRunner({"mem": MemoryConnector()}, sess,
+                                   n_workers=4, desired_splits=4)
+        r.execute("create table z (k bigint, v bigint)")
+        conn = r.metadata.connectors["mem"]
+        h = conn.metadata().get_table_handle("default", "z")
+        sink = conn.page_sink(h, conn.metadata().get_columns(h))
+        sink.append_page(Page.from_pylists(
+            [T.BIGINT, T.BIGINT],
+            [zkeys[:rows_per_task].tolist(),
+             zvals[:rows_per_task].tolist()]))
+        sink.finish()
+        r.execute("create table out as select k, v from z")
+        return sorted(r.execute("select k, v from out").rows)
+
+    reb_before = UniformPartitionRebalancer.total_rebalances
+    rows_plain = write(False)
+    rows_scaled = write(True)
+    rebalances = UniformPartitionRebalancer.total_rebalances - reb_before
+    writer_ok = rows_scaled == rows_plain and rebalances >= 1
+
+    out = {
+        "ok": exchange_ok and writer_ok,
+        "exchange_ok": exchange_ok,
+        "writer_ok": writer_ok,
+        "splits": s["splits"],
+        "hot_spread": s["hot_spread"],
+        "per_dest_split": s["per_dest"],
+        "per_dest_unsplit": ex_plain.stats["per_dest"],
+        "lane_skew_split": s["lane_skew_ratio"],
+        "lane_skew_unsplit": ex_plain.stats["lane_skew_ratio"],
+        "a2a_retries": ex_split.a2a_retries,
+        "rebalances": rebalances,
+        "rows_per_s": round(n_tasks * rows_per_task / wall_split, 1),
+        "wall_s": round(time.time() - t0, 2),
+    }
+    print("SKEW_RESULT " + json.dumps(out), flush=True)
+    if not out["ok"]:
+        raise SystemExit(6)
     return out
 
 
@@ -475,5 +595,7 @@ if __name__ == "__main__":
         _chaos_smoke()
     elif os.environ.get("BENCH_ROLE") == "memory":
         _memory_smoke()
+    elif os.environ.get("BENCH_ROLE") == "skew":
+        _skew_smoke()
     else:
         main()

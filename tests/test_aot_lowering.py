@@ -60,7 +60,8 @@ def test_device_exchange_program_lowers_for_tpu():
     cap = 128
     cols = tuple(sds((8, cap), jnp.int64) for _ in types_)
     nulls = tuple(sds((8, cap), jnp.bool_) for _ in types_)
-    ex = _export_tpu(prog, cols, nulls, sds((8, cap), jnp.bool_), ())
+    ex = _export_tpu(prog, cols, nulls, sds((8, cap), jnp.bool_), (),
+                     sds((8,), jnp.int32))  # the hot-partition mask
     assert "tpu" in ex.platforms
 
 

@@ -151,6 +151,33 @@ def test_bench_chaos_smoke_child():
 
 
 @pytest.mark.slow
+def test_bench_skew_smoke_child():
+    """The bench harness's skew role (BENCH_ROLE=skew): a zipf-keyed
+    device exchange with hot-partition splitting must byte-match the
+    unsplit oracle while spreading the hot partition over >= 2
+    receiver lanes with zero retries, and scaled-writer CTAS must
+    byte-match the unscaled plan while rebalancing — run as the real
+    child process so the skew code paths cannot rot outside the test
+    suite."""
+    env = dict(os.environ, BENCH_ROLE="skew", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [line for line in proc.stdout.splitlines()
+             if line.startswith("SKEW_RESULT ")]
+    assert len(lines) == 1, proc.stdout[-2000:]
+    out = json.loads(lines[0][len("SKEW_RESULT "):])
+    assert out["ok"] is True
+    assert out["splits"] >= 1
+    assert max(out["hot_spread"].values()) >= 2
+    assert out["a2a_retries"] == 0
+    assert out["lane_skew_split"] < out["lane_skew_unsplit"]
+    assert out["rebalances"] >= 1
+    assert out["rows_per_s"] > 0
+
+
+@pytest.mark.slow
 def test_bench_measure_child_micro_cpu():
     env = dict(os.environ, BENCH_ROLE="measure", BENCH_PLATFORM="cpu",
                BENCH_SCHEMA="micro", BENCH_QUERIES="q1,q18",

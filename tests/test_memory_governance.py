@@ -164,6 +164,99 @@ def test_disk_spilled_page_roundtrip():
     pool.close()
 
 
+def _device_page(rows: int):
+    import jax.numpy as jnp
+
+    from trino_tpu import types as T
+    from trino_tpu.block import DevicePage
+
+    return DevicePage([T.BIGINT], [jnp.arange(rows, dtype=jnp.int64)],
+                      [jnp.zeros(rows, dtype=bool)],
+                      jnp.ones(rows, dtype=bool), [None])
+
+
+def test_ledger_demotes_across_operator_lists():
+    """Cross-operator-list demotion (PR 4 follow-on): when the spilling
+    operator's own list cannot bring the node ledger under its limit,
+    the LARGEST parked pages of OTHER tracked lists demote — the last
+    spiller is rarely the biggest holder."""
+    node = NodeMemoryPool(1 << 30, host_spill_limit=1 << 30)
+    a = node.create_query_pool("qa", 1 << 30, spill_enabled=True,
+                               spill_to_disk=True)
+    b = node.create_query_pool("qb", 1 << 30, spill_enabled=True,
+                               spill_to_disk=True)
+    ca = a.create_context("a-agg")
+    cb = b.create_context("b-join")
+    # operator A parks BIG pages while the ledger has headroom
+    a_pages = [_device_page(4096), _device_page(4096)]
+    with ca.lock:
+        spill_pages(a_pages, a, ca.lock)
+    assert all(isinstance(p, SpilledPage) and
+               not isinstance(p, DiskSpilledPage) for p in a_pages)
+    # tighten the (shared, node-wide) limit, then operator B spills a
+    # SMALL page: its own list can't cover the overage
+    node.host_ledger.limit_bytes = 1024
+    b_pages = [_device_page(32)]
+    with cb.lock:
+        spill_pages(b_pages, b, cb.lock)
+    assert any(isinstance(p, DiskSpilledPage) for p in a_pages), \
+        "demotion never reached the other operator's list"
+    assert node.host_ledger.cross_list_demotions >= 1
+    # A's disk pages reload transparently and carry A's spill files
+    back = next(p for p in a_pages if isinstance(p, DiskSpilledPage))
+    assert os.path.exists(back.path)
+    assert int(np.asarray(back.to_device().valid).sum()) == 4096
+    # closing A drops its lists from the ledger's candidates
+    node.release_query("qa")
+    assert not any(t[2] is a for t in node.host_ledger._tracked)
+    node.release_query("qb")
+
+
+def test_ledger_cross_list_skips_busy_foreign_locks():
+    """A foreign operator actively holding its context lock is skipped
+    (never blocked on): cooperative demotion must not deadlock two
+    concurrently-spilling operators."""
+    node = NodeMemoryPool(1 << 30, host_spill_limit=1 << 30)
+    a = node.create_query_pool("qa", 1 << 30, spill_enabled=True,
+                               spill_to_disk=True)
+    b = node.create_query_pool("qb", 1 << 30, spill_enabled=True,
+                               spill_to_disk=True)
+    ca = a.create_context("a-op")
+    cb = b.create_context("b-op")
+    a_pages = [_device_page(4096)]
+    with ca.lock:
+        spill_pages(a_pages, a, ca.lock)
+    node.host_ledger.limit_bytes = 64
+
+    held = threading.Event()
+    release = threading.Event()
+
+    def hold_a():
+        with ca.lock:
+            held.set()
+            release.wait(5)
+
+    t = threading.Thread(target=hold_a)
+    t.start()
+    held.wait(5)
+    b_pages = [_device_page(32)]
+    with cb.lock:
+        spill_pages(b_pages, b, cb.lock)  # must return, not deadlock
+    assert not isinstance(a_pages[0], DiskSpilledPage)  # skipped
+    release.set()
+    t.join()
+    node.release_query("qa")
+    node.release_query("qb")
+
+
+def test_default_node_memory_bytes_falls_back_on_cpu():
+    from trino_tpu.exec.memory import default_node_memory_bytes
+
+    # the CPU backend reports no memory stats -> documented fallback
+    assert default_node_memory_bytes(fallback=123) in (123,) or \
+        default_node_memory_bytes(fallback=123) > 1 << 28
+
+
 # ------------------------------------------- node pool (cross-query) ----
 
 

@@ -44,9 +44,15 @@ class OperatorStats:
         if self.metrics:
             m = self.metrics
             extras = " ".join(
-                f"{k}={m[k]}" for k in ("skew_ratio", "per_dest",
-                                        "a2a_retries", "sizing")
+                f"{k}={m[k]}" for k in ("skew_ratio", "lane_skew_ratio",
+                                        "per_dest", "a2a_retries",
+                                        "sizing")
                 if m.get(k) is not None)
+            # split/rebalance counters only when the mechanism engaged
+            # (a zero on every boundary would be noise)
+            extras += "".join(
+                f" {k}={m[k]}" for k in ("splits", "rebalances")
+                if m.get(k))
             if extras:
                 base += f" [exchange {extras}]"
         return base

@@ -80,6 +80,26 @@ class NodeMemoryExceededError(TrinoError):
         self.limit = limit
 
 
+def default_node_memory_bytes(fallback: int = 16 << 30) -> int:
+    """Auto default for ``node_max_memory_bytes``: the accelerator's
+    own reported capacity (``Device.memory_stats()['bytes_limit']`` on
+    TPU/GPU backends), so the node pool tracks real HBM instead of a
+    hardwired constant. CPU backends report no stats — fall back.
+    Never raises: a worker must come up even on an odd backend."""
+    try:
+        import jax
+
+        stats = jax.local_devices()[0].memory_stats()
+        if stats:
+            limit = stats.get("bytes_limit") \
+                or stats.get("bytes_reservable_limit")
+            if limit:
+                return int(limit)
+    except Exception:
+        pass
+    return fallback
+
+
 def device_page_bytes(page) -> int:
     """Accounted HBM footprint of a DevicePage: padded columns + null
     masks + the valid mask.  Disk-parked pages carry their recorded
@@ -204,13 +224,29 @@ class HostSpillLedger:
     """Live host-RAM bytes held by SpilledPages, node-wide when a node
     pool exists.  Charged at spill time and discharged by a weakref
     finalizer when the parked page is dropped (uploaded back or
-    demoted), so residency tracks actual lifetime, not call sites."""
+    demoted), so residency tracks actual lifetime, not call sites.
+
+    The ledger also TRACKS the operator page lists holding parked
+    pages (with the context lock guarding each), so over-limit
+    demotion can run ACROSS operator lists: the operator that happens
+    to spill last is often not the one parking the biggest pages, and
+    demoting only its own list leaves the ledger over budget while
+    colder, larger state sits in RAM (reference: MemoryRevokingScheduler
+    picking victims pool-wide, not caller-local)."""
 
     def __init__(self, limit_bytes: Optional[int] = None):
         self.limit_bytes = limit_bytes
         self.resident_bytes = 0
         self.peak_bytes = 0
-        self._lock = threading.Lock()
+        self.cross_list_demotions = 0
+        # REENTRANT: dropping a SpilledPage reference can fire its
+        # ``_discharge`` finalizer on the dropping thread at any
+        # allocation/decref point — including while this very lock is
+        # held (the untrack_pool deadlock); an RLock absorbs that
+        self._lock = threading.RLock()
+        #: (pages list, guarding context lock, owning QueryMemoryPool);
+        #: entries die with their pool (untrack_pool at close)
+        self._tracked: List[tuple] = []
 
     def charge(self, page: SpilledPage) -> None:
         nbytes = page.host_bytes()
@@ -228,6 +264,78 @@ class HostSpillLedger:
             return self.limit_bytes is not None \
                 and self.resident_bytes > self.limit_bytes
 
+    # -- cross-operator-list demotion -----------------------------------
+
+    def track(self, pages: List, lock, pool: "QueryMemoryPool") -> None:
+        """Register an operator's revocable page list as a demotion
+        candidate (idempotent per list)."""
+        if pool.disk_spiller is None:
+            return  # its pages can never demote — don't scan them
+        with self._lock:
+            for ps, _, _ in self._tracked:
+                if ps is pages:
+                    return
+            self._tracked.append((pages, lock, pool))
+
+    def untrack_pool(self, pool: "QueryMemoryPool") -> None:
+        with self._lock:
+            dropped = [t for t in self._tracked if t[2] is pool]
+            self._tracked = [t for t in self._tracked
+                             if t[2] is not pool]
+        # the entries held the last strong refs to their page lists:
+        # release OUTSIDE the lock so the pages' discharge finalizers
+        # (which take it) fire lock-free
+        del dropped
+
+    def demote_across(self, exclude: Optional[List] = None) -> None:
+        """Demote in-RAM SpilledPages of OTHER tracked lists,
+        node-wide largest-first, while over limit.  Foreign context
+        locks are taken non-blocking: an operator actively mutating
+        its state is skipped rather than deadlocked against (the
+        caller already holds its OWN context lock — blocking on a
+        foreign one would create an AB-BA cycle with that operator's
+        own demotion; never blocking also makes holding several
+        foreign locks at once cycle-free, which is what lets the
+        candidate sort span every lockable list instead of draining
+        them one at a time in tracking order)."""
+        if not self.over_limit():
+            return
+        with self._lock:
+            tracked = list(self._tracked)
+        held = []
+        demoted = 0
+        try:
+            for pages, lock, pool in tracked:
+                if pages is exclude:
+                    continue
+                if not lock.acquire(blocking=False):
+                    continue
+                if pool.disk_spiller.closed:
+                    lock.release()  # pool closed after the snapshot
+                    continue
+                held.append((pages, lock, pool))
+            candidates = sorted(
+                ((i, pages, pool)
+                 for pages, _, pool in held
+                 for i, p in enumerate(pages)
+                 if isinstance(p, SpilledPage)
+                 and not isinstance(p, DiskSpilledPage)),
+                key=lambda t: -t[1][t[0]].host_bytes())
+            for i, pages, pool in candidates:
+                if not self.over_limit():
+                    break
+                try:
+                    pages[i] = pool.disk_spiller.spill(pages[i])
+                except RuntimeError:
+                    continue  # close() raced the spill; nothing leaked
+                demoted += 1
+        finally:
+            for _, lock, _ in held:
+                lock.release()
+        if demoted:
+            with self._lock:
+                self.cross_list_demotions += demoted
+
 
 class DiskSpiller:
     """Per-query spill-file manager: one directory per query, one
@@ -240,6 +348,7 @@ class DiskSpiller:
         self._dir: Optional[str] = None
         self._seq = 0
         self._lock = threading.Lock()
+        self.closed = False
         self.spill_events = 0
         self.spilled_bytes = 0       # uncompressed bytes demoted
         self.file_bytes = 0          # on-disk (compressed) bytes
@@ -248,6 +357,10 @@ class DiskSpiller:
         import tempfile
 
         with self._lock:
+            if self.closed:
+                # a cross-list demotion racing the owner's close must
+                # not resurrect the reaped spill directory
+                raise RuntimeError("spiller closed")
             if self._dir is None:
                 # env read per spiller, not at import: embedders may set
                 # the spill root after importing the package
@@ -286,6 +399,7 @@ class DiskSpiller:
         import shutil
 
         with self._lock:
+            self.closed = True
             d, self._dir = self._dir, None
         if d is not None:
             shutil.rmtree(d, ignore_errors=True)
@@ -298,12 +412,17 @@ def _remove_quiet(path: str):
         pass
 
 
-def spill_pages(pages: List, pool: "QueryMemoryPool" = None) -> int:
+def spill_pages(pages: List, pool: "QueryMemoryPool" = None,
+                lock=None) -> int:
     """Convert DevicePage entries to SpilledPage in place (caller holds
     the owning context's lock); returns the HBM bytes freed.  With a
     pool, host residency is charged to its ledger and — when the ledger
     is over its limit and disk spill is enabled — the largest parked
-    pages in this list demote to the disk tier."""
+    pages demote to the disk tier, in this list first and then across
+    every other tracked operator list.  ``lock`` is the context lock
+    guarding ``pages`` (i.e. the one the caller holds): passing it
+    registers the list so OTHER operators' over-limit demotions can
+    reach these pages too."""
     from ..block import DevicePage
 
     freed = 0
@@ -315,6 +434,8 @@ def spill_pages(pages: List, pool: "QueryMemoryPool" = None) -> int:
                 pool.host_ledger.charge(spilled)
             pages[i] = spilled
     if pool is not None:
+        if lock is not None:
+            pool.host_ledger.track(pages, lock, pool)
         pool.maybe_demote(pages)
     return freed
 
@@ -342,7 +463,7 @@ def prepare_finish(ctx: "OperatorMemoryContext", pages: List):
         freed = 0
         if pool.spill_enabled and \
                 pool.reserved + uploads + 2 * total > pool.max_bytes:
-            freed = spill_pages(pages, pool)
+            freed = spill_pages(pages, pool, ctx.lock)
             total = sum(device_page_bytes(p) for p in pages)
             uploads = total
         # clear the callback INSIDE the lock: a concurrent pool revoke
@@ -430,12 +551,22 @@ class QueryMemoryPool:
     # -- spill tiers ----------------------------------------------------
 
     def maybe_demote(self, pages: List):
-        """Demote the largest in-RAM SpilledPages of this list to disk
-        while the host ledger is over its limit (the host tier stays the
-        fast path; disk absorbs the overflow).  Largest-first order is
-        fixed up front — one sort, not a rescan per demotion."""
+        """Demote the largest in-RAM SpilledPages to disk while the
+        host ledger is over its limit (the host tier stays the fast
+        path; disk absorbs the overflow): this operator's own list
+        first (its context lock is already held by the caller), then
+        COOPERATIVELY across every other tracked operator list on the
+        node — the last spiller is rarely the biggest holder."""
         if self.disk_spiller is None or not self.host_ledger.over_limit():
             return
+        self._demote_list_locked(pages)
+        if self.host_ledger.over_limit():
+            self.host_ledger.demote_across(exclude=pages)
+
+    def _demote_list_locked(self, pages: List):
+        """Demote one list largest-first (caller holds the list's
+        guarding context lock).  Largest-first order is fixed up front —
+        one sort, not a rescan per demotion."""
         order = sorted(
             (i for i, p in enumerate(pages)
              if isinstance(p, SpilledPage)
@@ -554,6 +685,9 @@ class QueryMemoryPool:
             contexts = list(self._contexts)
         for c in contexts:
             c.close()
+        # drop this query's page lists from the node ledger's demotion
+        # candidates BEFORE the spill dir dies with the spiller
+        self.host_ledger.untrack_pool(self)
         if self.disk_spiller is not None:
             self.disk_spiller.close()
 

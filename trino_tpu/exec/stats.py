@@ -79,6 +79,19 @@ class StageStatsTree:
         if ex.get("per_dest") is not None:
             parts.append(f", per_dest={ex['per_dest']}")
         parts.append(f", retries={ex.get('a2a_retries', 0)}")
+        if ex.get("splits"):
+            # hot partitions split across receiver lanes, e.g.
+            # "splits=1x4 (lane skew 1.02)" — the receive-side answer
+            # to one partition capping the collective
+            parts.append(
+                f", splits={ex['splits']}x{ex.get('split_ways', 1)}"
+                f" (lane skew {ex.get('lane_skew_ratio', 0.0):.2f})")
+        if ex.get("rebalances") is not None:
+            parts.append(
+                f", rebalances={ex['rebalances']}"
+                f" ({ex.get('scaled_partitions', 0)} scaled/"
+                f"{ex.get('logical_partitions', 0)} logical -> "
+                f"{ex.get('writer_lanes', 0)} lanes)")
         if ex.get("data_collectives"):
             parts.append(
                 f", collectives={ex.get('count_collectives', 0)}"

@@ -508,7 +508,8 @@ class HashAggregationOperator(Operator):
         overflowing to the disk tier when the host ledger is full."""
         from ..exec.memory import spill_pages
 
-        return spill_pages(self._partials, self._ctx.pool)
+        return spill_pages(self._partials, self._ctx.pool,
+                           self._ctx.lock)
 
     def _aggregate_page(self, page: DevicePage,
                         intermediate: bool) -> DevicePage:

@@ -206,7 +206,7 @@ class HashBuilderOperator(Operator):
         with the disk tier below host RAM when the ledger overflows)."""
         from ..exec.memory import spill_pages
 
-        return spill_pages(self._pages, self._ctx.pool)
+        return spill_pages(self._pages, self._ctx.pool, self._ctx.lock)
 
     def get_output(self):
         if self._finishing and not self._done:

@@ -61,6 +61,12 @@ class OutputBuffer:
       (reference: PipelinedQueryScheduler's streaming exchanges).
     """
 
+    #: scaled-writer boundaries attach their UniformPartitionRebalancer
+    #: here so the STAGE-level stats surface (EXPLAIN ANALYZE exchange
+    #: line) carries the rebalance counters, same as the producer
+    #: operator's metrics
+    rebalancer = None
+
     def __init__(self, num_partitions: int, broadcast: bool = False,
                  max_pending_pages: Optional[int] = None):
         self.num_partitions = num_partitions
@@ -244,7 +250,7 @@ class OutputBuffer:
         with self._lock:
             rows = list(self._partition_rows)
         mean_rows = (sum(rows) / len(rows)) if rows else 0.0
-        return {
+        out = {
             "kind": "host",
             "sizing": None,
             "per_dest": None,
@@ -256,6 +262,9 @@ class OutputBuffer:
             "skew_ratio": (round(max(rows) / mean_rows, 3)
                            if mean_rows > 0 else 0.0),
         }
+        if self.rebalancer is not None:
+            out.update(self.rebalancer.stats())
+        return out
 
     @property
     def overlapped(self) -> bool:
@@ -310,13 +319,27 @@ class PartitionedOutputOperator(Operator):
 
     def __init__(self, input_types: Sequence[T.Type],
                  key_channels: Sequence[int], buffer: OutputBuffer,
-                 kind: str = "hash", task_partition: int = 0):
+                 kind: str = "hash", task_partition: int = 0,
+                 rebalancer=None):
         assert kind in ("hash", "single", "broadcast", "merge")
         self.input_types = list(input_types)
         self.key_channels = list(key_channels)
         self.buffer = buffer
         self.kind = kind
         self.task_partition = task_partition
+        #: scaled-writer boundary: a UniformPartitionRebalancer mapping
+        #: MORE logical hash partitions than writer lanes; hot logical
+        #: partitions are scaled across several lanes (rows round-robin
+        #: within the assigned set), re-assigned from observed counts
+        #: (reference: ScaleWriterPartitioningExchanger). Writer lanes
+        #: don't need key co-location, so remapping is free to chase
+        #: balance — the generic hash path must NOT set this.
+        self.rebalancer = rebalancer
+        #: per-logical-partition round-robin cursor, persistent ACROSS
+        #: pages — restarting at lane 0 each page would concentrate a
+        #: scaled partition's rows on its first lane under small pages
+        #: (the reference exchanger keeps this counter per partition)
+        self._rr: Dict[int, int] = {}
         self._done = False
         self._lut_cache: Dict[tuple, np.ndarray] = {}
 
@@ -355,8 +378,11 @@ class PartitionedOutputOperator(Operator):
                     self._lut_cache[key] = lut
                 lut = jnp.asarray(lut)
             keys_u64.append(key_to_u64(page.cols[c], page.nulls[c], t, lut))
-        part = np.asarray(hash_partition_ids(keys_u64, n))
+        n_logical = self.rebalancer.n if self.rebalancer is not None else n
+        part = np.asarray(hash_partition_ids(keys_u64, n_logical))
         valid = np.asarray(page.valid)
+        if self.rebalancer is not None:
+            part = self._rebalanced_lanes(part, valid)
         cols = [np.asarray(c) for c in page.cols]
         nulls = [np.asarray(x) for x in page.nulls]
         for p in range(n):
@@ -370,9 +396,34 @@ class PartitionedOutputOperator(Operator):
                 blocks.append(Block(t, c[idx], bn if bn.any() else None, d))
             self.buffer.enqueue(p, Page(blocks, len(idx)))
 
+    def _rebalanced_lanes(self, part: np.ndarray,
+                          valid: np.ndarray) -> np.ndarray:
+        """Logical partition ids -> writer lanes through the current
+        rebalancer assignment; feeds the observation that adapts it.
+        Scaled partitions round-robin their rows across the assigned
+        lane set by row position (deterministic)."""
+        reb = self.rebalancer
+        reb.observe(np.bincount(part[valid], minlength=reb.n)[:reb.n])
+        assignment = reb.assignment()
+        first_lane = np.asarray([lanes[0] for lanes in assignment],
+                                dtype=part.dtype)
+        lane = first_lane[part]
+        for lp, lanes in enumerate(assignment):
+            if len(lanes) <= 1:
+                continue
+            idx = np.nonzero(valid & (part == lp))[0]
+            if len(idx):
+                start = self._rr.get(lp, 0)
+                lane[idx] = np.asarray(lanes)[
+                    (start + np.arange(len(idx))) % len(lanes)]
+                self._rr[lp] = (start + len(idx)) % len(lanes)
+        return lane
+
     def metrics(self) -> Optional[dict]:
         """Host-path exchange stats for OperatorStats (hash kind only:
-        single/broadcast/merge routing has no skew to observe)."""
+        single/broadcast/merge routing has no skew to observe).
+        Rebalancer counters already ride buffer.stats — the buffer is
+        the one merge point."""
         if self.kind != "hash":
             return None
         return self.buffer.stats

@@ -103,7 +103,7 @@ class OrderByOperator(Operator):
     def _revoke(self) -> int:
         from ..exec.memory import spill_pages
 
-        return spill_pages(self._pages, self._ctx.pool)
+        return spill_pages(self._pages, self._ctx.pool, self._ctx.lock)
 
     def _pop_out(self) -> DevicePage:
         item = self._out.pop(0)

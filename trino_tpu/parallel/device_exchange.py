@@ -37,10 +37,29 @@ session property):
   that pays the full shuffle twice or more (the 2x cost cliff the
   count-first pass removes).
 
+Hot-partition SPLITTING (scaled receivers): lanes are per (sender,
+dest) pair, so ONE partition holding most of the rows caps the whole
+collective at a single receiver lane's capacity however the collective
+is sized — the workload count-first sizing alone cannot fix (reference:
+``ScaleWriterPartitioningExchanger`` + ``UniformPartitionRebalancer``).
+When the count pass's per-partition histogram (or the sizing history's
+remembered partition fractions) shows a partition above
+``hot_partition_split_threshold`` of the exchange's rows, the jit'd
+``_exchange_program`` SALTS that partition's destination with a
+row-index-derived sub-bucket — its rows spread across ALL d receiver
+devices — and the consumer-side ``pages(partition)`` gather re-merges
+the sub-buckets (each partition's pages may now come from several
+device slabs; the original partition id is carried through the
+collective, so co-location per CONSUMER TASK is preserved, which is all
+downstream aggregation/join operators require). The hot set rides into
+the compiled program as a TRACED (n,) mask argument, so split and
+unsplit runs of the same shape share one cache entry — no recompiles.
+
 Every collective records skew observability into ``self.stats``:
-per-partition row counts, max/mean skew ratio, per_dest chosen, retries,
-collective count and bytes moved — surfaced through OperatorStats /
-EXPLAIN ANALYZE and the bench output.
+per-partition row counts, max/mean skew ratio, per-receiver lane loads,
+hot partitions split and the receiver lanes they spread across,
+per_dest chosen, retries, collective count and bytes moved — surfaced
+through OperatorStats / EXPLAIN ANALYZE and the bench output.
 """
 
 from __future__ import annotations
@@ -86,8 +105,19 @@ class ExchangeSizingHistory:
         self._lock = threading.Lock()
         self._ewma: Dict[tuple, float] = {}
         self._obs: Dict[tuple, int] = {}
+        #: last observed per-partition row FRACTIONS per shape — the
+        #: hot-partition-split decision for a history-presized repeat
+        #: (no count pass ran, so the hot set must be remembered too)
+        self._fracs: Dict[tuple, list] = {}
+        #: scaled-writer rebalancers keyed by exchange shape — same
+        #: lifetime as the sizing EWMAs they ride with, so a repeat
+        #: query reuses the learned partition->lane assignment instead
+        #: of re-converging (reference: UniformPartitionRebalancer
+        #: living on the long-lived exchange, not the query)
+        self._rebalancers: Dict[tuple, object] = {}
 
-    def observe(self, key: tuple, max_load: int) -> None:
+    def observe(self, key: tuple, max_load: int,
+                fractions: Optional[Sequence[float]] = None) -> None:
         with self._lock:
             prev = self._ewma.get(key)
             if prev is None or max_load >= prev:
@@ -100,6 +130,8 @@ class ExchangeSizingHistory:
                 self._ewma[key] = (self.alpha * max_load
                                    + (1 - self.alpha) * prev)
             self._obs[key] = self._obs.get(key, 0) + 1
+            if fractions is not None:
+                self._fracs[key] = list(fractions)
 
     def presize(self, key: tuple) -> Optional[int]:
         """pow2-bucketed per_dest, or None while unconfident (no
@@ -109,10 +141,28 @@ class ExchangeSizingHistory:
                 return None
             return padded_size(max(int(round(self._ewma[key])), 16))
 
+    def fractions(self, key: tuple) -> Optional[list]:
+        """Last observed per-partition row fractions for this shape
+        (None until observed) — feeds the presized hot-set decision."""
+        with self._lock:
+            fr = self._fracs.get(key)
+            return list(fr) if fr is not None else None
+
+    def rebalancer(self, key: tuple, factory):
+        """The process-wide scaled-writer rebalancer for this exchange
+        shape, created on first use by ``factory()``."""
+        with self._lock:
+            rb = self._rebalancers.get(key)
+            if rb is None:
+                rb = self._rebalancers[key] = factory()
+            return rb
+
     def reset(self) -> None:
         with self._lock:
             self._ewma.clear()
             self._obs.clear()
+            self._fracs.clear()
+            self._rebalancers.clear()
 
 
 #: the process-wide sizing history (one engine process = one history,
@@ -135,7 +185,8 @@ class DeviceExchange:
 
     def __init__(self, n_partitions: int, devices: Sequence,
                  sizing: str = "history",
-                 history_key: Optional[tuple] = None):
+                 history_key: Optional[tuple] = None,
+                 hot_split_threshold: float = 0.5):
         # p-partitions-on-d-devices layout: with fewer devices than
         # partitions (a single real chip being the important case),
         # partition p lives on device p % d; partition ids are carried
@@ -150,6 +201,10 @@ class DeviceExchange:
         #: history key override (defaults to the exchange shape —
         #: types/key_channels/n/d — at collect time)
         self.history_key = history_key
+        #: a partition holding MORE than this fraction of the
+        #: exchange's rows is split across all d receiver devices
+        #: (>= 1.0 disables splitting; single-device meshes never split)
+        self.hot_split_threshold = hot_split_threshold
         self.types: Optional[List[T.Type]] = None
         self.key_channels: Optional[List[int]] = None
         self._by_task: Dict[int, List[DevicePage]] = {}
@@ -200,6 +255,9 @@ class DeviceExchange:
     #: process-wide count of count-first sizing collectives (history
     #: hits skip them — assertable)
     total_count_collectives = 0
+    #: process-wide count of hot partitions split across receivers
+    #: (bench SKEW_RESULT / test observability)
+    total_splits = 0
     _total_lock = threading.Lock()
 
     # -- producer side --------------------------------------------------
@@ -315,28 +373,56 @@ class DeviceExchange:
             tuple(str(t) for t in types_), kkey, n, d)
         sizing = self.sizing
         mode_used = sizing
+        # hot-partition splitting is a non-legacy feature (legacy IS the
+        # pre-split baseline) and needs >= 2 receivers to spread over
+        splittable = (self.hot_split_threshold < 1.0 and d > 1
+                      and sizing != "legacy")
+        hot: set = set()
         per_dest = None
         if sizing == "history":
             per_dest = SIZING_HISTORY.presize(hkey)
             if per_dest is None:
                 mode_used = "exact"  # unconfident: fall back to counting
+            elif splittable:
+                # no count pass ran: the hot set comes from the
+                # history's remembered partition fractions
+                fracs = SIZING_HISTORY.fractions(hkey)
+                if fracs is not None:
+                    hot = {p for p, f in enumerate(fracs)
+                           if f > self.hot_split_threshold}
         if sizing == "exact" or (sizing == "history" and per_dest is None):
             # count-first pass: the exact max (sender, dest) load from a
             # tiny counting collective; per_dest needs no retry headroom
             cprog = _count_program(mesh, tkey, kkey, n, d)
-            _hist, need = cprog(cols, nulls, valid, luts)
-            per_dest = padded_size(max(int(np.asarray(need)[0]), 16))
+            hist, need, pair_max = cprog(cols, nulls, valid, luts)
+            hist = np.asarray(hist)[0]
             self.count_collectives += 1
             with DeviceExchange._total_lock:
                 DeviceExchange.total_count_collectives += 1
+            total = int(hist.sum())
+            if splittable and total:
+                hot = {p for p in range(n)
+                       if hist[p] / total > self.hot_split_threshold}
+            if hot:
+                pair_np = np.asarray(pair_max)[0].reshape(n, d)
+                per_dest = padded_size(max(_salted_need_bound(
+                    pair_np, hot, n, d), 16))
+            else:
+                per_dest = padded_size(max(int(np.asarray(need)[0]), 16))
         elif sizing == "legacy":
             per_dest = padded_size(max(32, (2 * cap) // d))
         per_dest = min(per_dest, cap)
+        # the hot set rides as a TRACED (n,) mask: split and unsplit
+        # runs of one shape share one compiled program (no recompiles)
+        hot_mask = np.zeros((n,), dtype=np.int32)
+        for p in hot:
+            hot_mask[p] = 1
+        hot_mask = jnp.asarray(hot_mask)
         lanes_moved = 0
         while True:
             prog = _exchange_program(mesh, tkey, kkey, n, d, per_dest)
             out_cols, out_nulls, out_valid, out_part, overflow = prog(
-                cols, nulls, valid, luts)
+                cols, nulls, valid, luts, hot_mask)
             jax.block_until_ready(out_valid)
             self.data_collectives += 1
             lanes_moved += d * d * per_dest  # at THIS attempt's capacity
@@ -365,9 +451,29 @@ class DeviceExchange:
         op_ids = np.asarray(out_part)
         pair_rows = ov.reshape(d, d, per_dest).sum(axis=2)
         observed_max = int(pair_rows.max()) if pair_rows.size else 0
-        SIZING_HISTORY.observe(hkey, observed_max)
         partition_rows = np.bincount(op_ids[ov], minlength=n)[:n]
+        total_rows = int(partition_rows.sum())
+        SIZING_HISTORY.observe(
+            hkey, observed_max,
+            fractions=(partition_rows / total_rows).tolist()
+            if total_rows else None)
         mean_rows = float(partition_rows.mean()) if n else 0.0
+        # per-receiver-DEVICE loads: the number splitting actually moves
+        # (partition skew is a property of the DATA and stays put;
+        # spreading a hot partition flattens the receiver lanes)
+        lane_rows = ov.reshape(d, -1).sum(axis=1)
+        lane_mean = float(lane_rows.mean()) if d else 0.0
+        # which receiver devices ended up holding each hot partition's
+        # rows: the acceptance witness (>= 2 lanes under real skew) AND
+        # the consumer-gather device list below
+        devs_for = {
+            p: [dev for dev in range(d)
+                if ((op_ids[dev] == p) & ov[dev]).any()]
+            for p in sorted(hot)}
+        hot_spread = {p: len(devs) for p, devs in devs_for.items()}
+        if hot:
+            with DeviceExchange._total_lock:
+                DeviceExchange.total_splits += len(hot)
         lane_bytes = (sum(np.dtype(t.storage).itemsize for t in types_)
                       + 4          # carried partition id (int32)
                       + nch + 1)   # null masks + valid mask (bool lanes)
@@ -380,10 +486,17 @@ class DeviceExchange:
             "a2a_retries": self.a2a_retries,
             "count_collectives": self.count_collectives,
             "data_collectives": self.data_collectives,
-            "rows": int(partition_rows.sum()),
+            "rows": total_rows,
             "partition_rows": [int(r) for r in partition_rows],
             "skew_ratio": (round(float(partition_rows.max()) / mean_rows, 3)
                            if mean_rows > 0 else 0.0),
+            "lane_rows": [int(r) for r in lane_rows],
+            "lane_skew_ratio": (round(float(lane_rows.max()) / lane_mean, 3)
+                                if lane_mean > 0 else 0.0),
+            "hot_partitions": sorted(hot),
+            "splits": len(hot),
+            "split_ways": d if hot else 1,
+            "hot_spread": hot_spread,
             "bytes_moved": lanes_moved * lane_bytes,
         }
         # release producer-side inputs: without this the exchange pins
@@ -392,16 +505,52 @@ class DeviceExchange:
         out_dicts = list(target)
         result: List[List[DevicePage]] = []
         for p in range(n):
-            dev = p % d
-            pv = out_valid[dev]
-            if d < n:  # split the device slab by carried partition id
-                pv = pv & (out_part[dev] == p)
-            dp = DevicePage(list(types_),
-                            [c[dev] for c in out_cols],
-                            [x[dev] for x in out_nulls],
-                            pv, out_dicts)
-            result.append([dp])
+            if p in hot:
+                # a split partition's rows landed on several devices:
+                # gather its sub-buckets (the downstream "merge" — one
+                # DevicePage per receiver slab actually holding rows)
+                devs = devs_for[p] or [p % d]
+            else:
+                devs = [p % d]
+            pages: List[DevicePage] = []
+            for dev in devs:
+                pv = out_valid[dev]
+                if d < n or hot:
+                    # split the device slab by carried partition id
+                    # (with any split active, even n == d slabs hold
+                    # foreign partitions' sub-buckets)
+                    pv = pv & (out_part[dev] == p)
+                pages.append(DevicePage(list(types_),
+                                        [c[dev] for c in out_cols],
+                                        [x[dev] for x in out_nulls],
+                                        pv, out_dicts))
+            result.append(pages)
         return result
+
+
+def _salted_need_bound(pair_max: np.ndarray, hot: set, n: int,
+                       d: int) -> int:
+    """Safe upper bound on the max (sender, dest) lane load under the
+    salted destination map, from the count pass's per-(partition,
+    sub-bucket) per-sender maxima (``pair_max[p, sub]`` = pmax over
+    senders of that sender's rows with partition p in sub-bucket sub).
+
+    Per destination r, any single sender contributes at most: its rows
+    of every UNSPLIT partition homed at r (bounded by the partition's
+    per-sender max, i.e. pair_max summed over sub) plus, for every HOT
+    partition, exactly its rows in the one sub-bucket that maps to r.
+    pmax over senders bounds each term independently, so the sum bounds
+    every sender — sized from it, the data collective cannot overflow
+    (zero retries by construction, like the unsplit exact mode)."""
+    per_part = pair_max.sum(axis=1)  # >= any sender's rows of partition p
+    need = np.zeros(d, dtype=np.int64)
+    for p in range(n):
+        if p in hot:
+            for sub in range(d):
+                need[(p + sub) % d] += pair_max[p, sub]
+        else:
+            need[p % d] += per_part[p]
+    return int(need.max()) if need.size else 0
 
 
 def _normalized_keys(cols, nulls, luts, types_: tuple,
@@ -429,12 +578,15 @@ def _count_program(mesh: Mesh, types_: tuple, key_channels: tuple,
     and a pmax the exact max (sender, dest) lane load — O(n*d) scalars
     over the mesh, negligible vs the payload it sizes (the DrJAX
     observation: small pre-collectives are essentially free relative to
-    the data movement). Memoized on (mesh, types, keys, n, d); jit
+    the data movement). Also pmaxes the per-(partition, sub-bucket)
+    histogram (n*d scalars) so the host can size the SALTED map exactly
+    if it then decides to split a hot partition — one count collective
+    covers both layouts. Memoized on (mesh, types, keys, n, d); jit
     re-traces per sender capacity only."""
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P("x"), P("x"), P("x"), P(None)),
-             out_specs=(P("x"), P("x")),
+             out_specs=(P("x"), P("x"), P("x")),
              check_vma=False)
     def count(cols, nulls, valid, luts):
         cols = tuple(c[0] for c in cols)
@@ -443,11 +595,17 @@ def _count_program(mesh: Mesh, types_: tuple, key_channels: tuple,
         keys = _normalized_keys(cols, nulls, luts, types_, key_channels)
         part = hash_partition_ids(keys, n)
         dest = part % d if d < n else part
+        # the sub-bucket MUST match _exchange_program's salt exactly
+        # (same lane layout -> same arange), or exact sizing of a split
+        # run silently overflows
+        sub = jnp.arange(valid.shape[0], dtype=jnp.int32) % d
         part_hist = partition_histogram(part, valid, n)
+        pair_hist = partition_histogram(part * d + sub, valid, n * d)
         pair_need = jnp.max(partition_histogram(dest, valid, d))
         total_hist = jax.lax.psum(part_hist, "x")
         max_need = jax.lax.pmax(pair_need, "x")
-        return total_hist[None], max_need[None]
+        pair_max = jax.lax.pmax(pair_hist, "x")
+        return total_hist[None], max_need[None], pair_max[None]
 
     def counted(cols, nulls, valid, luts):
         jit_stats.bump("device_exchange_count")
@@ -466,19 +624,28 @@ def _exchange_program(mesh: Mesh, types_: tuple, key_channels: tuple,
     With d < n the collective routes to DEVICE p % d and the partition id
     rides along as an extra carried channel so the consumer can split its
     slab; with d == n device == partition and the carry is still returned
-    (cheap) but unused."""
+    (cheap) but unused.
+
+    ``hot`` is a TRACED (n,) int32 mask of hot partitions: a hot
+    partition's rows salt their destination with a row-index-derived
+    sub-bucket — ``(home + lane_index % d) % d`` — spreading ONE
+    partition's rows across all d receivers while the carried original
+    partition id lets the consumer gather re-merge them. Traced (not a
+    cache key) so split and unsplit runs share the compiled program."""
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P("x"), P("x"), P("x"), P(None)),
+             in_specs=(P("x"), P("x"), P("x"), P(None), P(None)),
              out_specs=(P("x"), P("x"), P("x"), P("x"), P("x")),
              check_vma=False)
-    def prog(cols, nulls, valid, luts):
+    def prog(cols, nulls, valid, luts, hot):
         cols = tuple(c[0] for c in cols)
         nulls = tuple(x[0] for x in nulls)
         valid = valid[0]
         keys = _normalized_keys(cols, nulls, luts, types_, key_channels)
         part = hash_partition_ids(keys, n)
-        dest = part % d if d < n else part
+        base = part % d  # == part when d == n (part < n)
+        sub = jnp.arange(valid.shape[0], dtype=jnp.int32) % d
+        dest = jnp.where(hot[part] > 0, (base + sub) % d, base)
         false_ = jnp.zeros(valid.shape, dtype=bool)
         ex_cols, ex_nulls, ex_valid, overflow = repartition_a2a(
             cols + (part,), nulls + (false_,), valid, dest,
@@ -487,12 +654,12 @@ def _exchange_program(mesh: Mesh, types_: tuple, key_channels: tuple,
                 tuple(x[None] for x in ex_nulls[:-1]),
                 ex_valid[None], ex_cols[-1][None], overflow[None])
 
-    def exchanged(cols, nulls, valid, luts):
+    def exchanged(cols, nulls, valid, luts, hot):
         # trace-time counter OUTSIDE the shard_map body (which jax may
         # re-trace for lowering): exactly one bump per XLA cache miss,
         # so "repeat shapes do not recompile" is assertable
         jit_stats.bump("device_exchange_program")
-        return prog(cols, nulls, valid, luts)
+        return prog(cols, nulls, valid, luts, hot)
 
     return jax.jit(exchanged)
 

@@ -70,7 +70,8 @@ class DistributedQueryRunner:
         root = add_exchanges(
             root, self.metadata, planner.allocator,
             self.broadcast_threshold,
-            SP.value(self.session, "join_distribution_type"))
+            SP.value(self.session, "join_distribution_type"),
+            scale_writers=SP.value(self.session, "scale_writers_enabled"))
         if trace is not None:  # exchange planning rebuilt the root node
             root.optimizer_trace = trace
         self._root = root
@@ -88,7 +89,11 @@ class DistributedQueryRunner:
     def execute(self, sql: str) -> QueryResult:
         stmt = parse_statement(sql)
         if isinstance(stmt, ast.Explain) and stmt.analyze and \
-                isinstance(stmt.statement, ast.QueryStatement):
+                isinstance(stmt.statement, (ast.QueryStatement,
+                                            ast.Insert,
+                                            ast.CreateTableAsSelect)):
+            # DML included: the writer path's exchange surface (scaled
+            # writers' rebalance counters) is only observable here
             return self._explain_analyze(stmt.statement)
         if not isinstance(stmt, ast.QueryStatement):
             if isinstance(stmt, (ast.Insert, ast.CreateTableAsSelect)):
@@ -226,6 +231,7 @@ class DistributedQueryRunner:
                 else:
                     out = OutputBuffer(self.n_workers,
                                        max_pending_pages=max_pending)
+                    out.rebalancer = self._rebalancer_for(frag)
                 buffers[frag.fragment_id] = out
             plans.append((frag, ntasks, out))
 
@@ -366,7 +372,8 @@ class DistributedQueryRunner:
             else:
                 ops.append(PartitionedOutputOperator(
                     types_, key_channels, out, frag.output_kind,
-                    task_partition=t))
+                    task_partition=t,
+                    rebalancer=getattr(out, "rebalancer", None)))
             planner.pipelines.append(PhysicalPipeline(ops))
             pipelines = planner.pipelines
         for p in pipelines:
@@ -392,6 +399,18 @@ class DistributedQueryRunner:
         if collect:
             stage.tasks.append(task)
 
+    def _rebalancer_for(self, frag: PlanFragment):
+        """The scaled-writer rebalancer for a scale_writers hash
+        boundary (see rebalancer.writer_rebalancer for the sharing
+        contract)."""
+        if frag.output_kind != "hash" or not frag.scale_writers:
+            return None
+        from .rebalancer import writer_rebalancer
+
+        return writer_rebalancer(
+            (str(s.type) for s in frag.output_symbols), self.n_workers,
+            SP.value(self.session, "rebalance_min_collectives"))
+
     def _device_exchange_for(self, frag: PlanFragment, ntasks: int):
         """The flagship TPU-native path: a hash stage boundary between
         co-resident stages runs as one all_to_all collective over the
@@ -400,6 +419,11 @@ class DistributedQueryRunner:
         from .. import session_properties as SP
 
         if frag.output_kind != "hash" or ntasks != self.n_workers:
+            return None
+        if frag.scale_writers:
+            # scaled-writer boundaries rebalance on the HOST: the
+            # partition->lane map mutates across pages, which a compiled
+            # collective cannot follow (and writers consume host pages)
             return None
         if not SP.value(self.session, "device_exchange"):
             return None
@@ -418,7 +442,9 @@ class DistributedQueryRunner:
         devices = jax.devices()
         return DeviceExchange(
             self.n_workers, devices,
-            sizing=SP.value(self.session, "device_exchange_sizing"))
+            sizing=SP.value(self.session, "device_exchange_sizing"),
+            hot_split_threshold=SP.value(
+                self.session, "hot_partition_split_threshold"))
 
     def _run_fragment(self, executor, frag: PlanFragment, ntasks: int,
                       buffers: Dict[int, OutputBuffer]):
@@ -435,6 +461,7 @@ class DistributedQueryRunner:
             out = OutputBuffer(self.n_workers, broadcast=True)
         else:
             out = OutputBuffer(self.n_workers)
+            out.rebalancer = self._rebalancer_for(frag)
 
         from ..exec.stats import StageStatsTree
 

@@ -40,7 +40,7 @@ from ..ops.aggregation import (_final_project, _group_reduce, _merge_states,
                                _state_plan)
 from ..ops.sortkeys import group_operands
 from .exchange import (hash_partition_ids, partition_histogram,
-                       repartition_a2a, shard_map)
+                       repartition_a2a, shard_map, subbucket_ids)
 
 
 #: memoized SPMD programs + expression builds: jax.jit caches live on
@@ -129,26 +129,39 @@ def q1_exchange_final_fn(mesh: Mesh, proc, aggs, per_dest: int):
     partial groups at the (count-first or caller-pinned) ``per_dest``,
     then merge-final aggregation on the owning device. Separate from
     stage 1 so a backstop retry re-runs ONLY the shuffle, never the
-    scan/partial-agg."""
+    scan/partial-agg.
+
+    ``hot`` is a TRACED (n,) hot-partition mask: a hot partition's
+    groups salt their destination with a KEY-derived sub-bucket —
+    unlike the generic device exchange's row-index salt, every partial
+    of one group shares a sub-bucket, so the group still meets on
+    exactly one device and the per-device merge-final aggregation
+    stays correct with no extra merge stage. Traced, not a cache key:
+    split and unsplit runs share the compiled program."""
     n = mesh.devices.size
     key_types = proc.output_types[:2]
     kinds = tuple(k for a in aggs for (k, _) in _state_plan(a))
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P("x"), P("x"), P("x"), P("x"), P("x")),
+             in_specs=(P("x"), P("x"), P("x"), P("x"), P("x"), P(None)),
              out_specs=(P("x"), P("x"), P("x"), P("x")),
              check_vma=False)
-    def dist(kr, kn, states, pvalid, part):
+    def dist(kr, kn, states, pvalid, part, hot):
         kr = tuple(k[0] for k in kr)
         kn = tuple(b[0] for b in kn)
         states = tuple(s[0] for s in states)
         pvalid = pvalid[0]
         part = part[0]
+        keys_u64 = [jnp.where(jnp.asarray(b), jnp.uint64(0),
+                              k.astype(jnp.int64).view(jnp.uint64))
+                    for k, b in zip(kr, kn)]
+        sub = subbucket_ids(keys_u64, n)
+        dest = jnp.where(hot[part] > 0, (part + sub) % n, part)
         ex_cols, ex_nulls, ex_valid, overflow = repartition_a2a(
             tuple(kr) + tuple(states),
             tuple(kn) + tuple(
                 jnp.zeros(s.shape, dtype=bool) for s in states),
-            pvalid, part, num_partitions=n, per_dest=per_dest)
+            pvalid, dest, num_partitions=n, per_dest=per_dest)
         # merge-final aggregation of received partial states
         key_ops: List = []
         for i, t in enumerate(key_types):
@@ -180,9 +193,9 @@ def q1_exchange_final_fn(mesh: Mesh, proc, aggs, per_dest: int):
                 tuple(x[None] for x in fin_nulls),
                 out_valid[None], overflow[None])
 
-    def exchanged(kr, kn, states, pvalid, part):
+    def exchanged(kr, kn, states, pvalid, part, hot):
         jit_stats.bump("mesh_q1_exchange_final")
-        return dist(kr, kn, states, pvalid, part)
+        return dist(kr, kn, states, pvalid, part, hot)
 
     return jax.jit(exchanged)
 
@@ -190,7 +203,8 @@ def q1_exchange_final_fn(mesh: Mesh, proc, aggs, per_dest: int):
 def run_q1_mesh(devices: Sequence, schema: str = "micro",
                 per_dest: Optional[int] = None,
                 max_per_dest: int = 1 << 16,
-                stats_out: Optional[dict] = None):
+                stats_out: Optional[dict] = None,
+                hot_split_threshold: Optional[float] = None):
     """Execute distributed q1 over the mesh.
 
     ``per_dest=None`` (default) sizes the exchange count-first: stage 1
@@ -199,6 +213,13 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
     guess (tests use per_dest=1 to exercise the doubling backstop).
     ``stats_out``, when given, is filled with the exchange's skew stats
     (partition_rows, skew_ratio, per_dest, retries, collectives).
+
+    ``hot_split_threshold`` (None = off) enables hot-partition
+    splitting: a partition above that fraction of stage 1's live
+    groups spreads its groups across receivers by key-derived
+    sub-bucket (aggregation-safe — every group still meets on exactly
+    one device). Sizing keeps the UNSALTED count (an upper bound in
+    the common case); the doubling backstop covers the remainder.
 
     Returns (result_rows, n_overflow_retries, connector, scanned_pages) —
     the latter two so callers can re-run the same data locally for the
@@ -239,6 +260,19 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
     if per_dest is None:
         per_dest = padded_size(max(exact_need, 16))
 
+    # hot-partition split decision from stage 1's live-group histogram
+    # (the same count the sizing pass already paid for)
+    total_groups = int(part_rows.sum())
+    hot: set = set()
+    if hot_split_threshold is not None and hot_split_threshold < 1.0 \
+            and n > 1 and total_groups:
+        hot = {p for p in range(n)
+               if part_rows[p] / total_groups > hot_split_threshold}
+    hot_mask = np.zeros((n,), dtype=np.int32)
+    for p in hot:
+        hot_mask[p] = 1
+    hot_mask = jnp.asarray(hot_mask)
+
     retries = 0
     collectives = 0
     while True:
@@ -246,7 +280,7 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
             ("final", mesh, tsig, per_dest),
             lambda: q1_exchange_final_fn(mesh, proc, aggs, per_dest))
         out_cols, out_nulls, out_valid, overflow = fn(
-            kr, kn, states, pvalid, part)
+            kr, kn, states, pvalid, part, hot_mask)
         jax.block_until_ready(out_valid)
         collectives += 1
         if int(np.asarray(overflow).sum()) == 0:
@@ -267,6 +301,9 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
             "partition_rows": [int(r) for r in part_rows],
             "skew_ratio": (round(float(part_rows.max()) / mean_rows, 3)
                            if mean_rows > 0 else 0.0),
+            "hot_partitions": sorted(hot),
+            "splits": len(hot),
+            "split_ways": n if hot else 1,
         })
 
     # assemble the distributed result: compact valid lanes per device

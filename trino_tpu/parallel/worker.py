@@ -118,12 +118,16 @@ class WorkerServer:
         if op == "configure":
             from .. import session_properties as SP
             from ..connectors.catalog import create_catalogs
-            from ..exec.memory import NodeMemoryPool
+            from ..exec.memory import (NodeMemoryPool,
+                                       default_node_memory_bytes)
 
             self.connectors = create_catalogs(req["catalogs"])
             self.properties = dict(req.get("properties", {}))
+            # 0 = auto: size the node pool from what the device
+            # actually has instead of a hardwired constant
             self.node_pool = NodeMemoryPool(
-                SP.prop_value(self.properties, "node_max_memory_bytes"),
+                SP.prop_value(self.properties, "node_max_memory_bytes")
+                or default_node_memory_bytes(),
                 host_spill_limit=SP.prop_value(
                     self.properties, "spill_host_memory_bytes"))
             send_msg(sock, {"ok": True})
@@ -516,8 +520,20 @@ class WorkerServer:
                 else req["n_partitions"],
                 broadcast=frag.output_kind == "broadcast")
             state.buffer = buffer
+        rebalancer = None
+        if frag.output_kind == "hash" and getattr(frag, "scale_writers",
+                                                  False):
+            from .. import session_properties as SP
+            from .rebalancer import writer_rebalancer
+
+            rebalancer = writer_rebalancer(
+                (str(t) for t in types_), req["n_partitions"],
+                SP.prop_value(session_props,
+                              "rebalance_min_collectives"))
+            buffer.rebalancer = rebalancer  # stage-level stats surface
         ops.append(PartitionedOutputOperator(types_, key_channels, buffer,
-                                             frag.output_kind))
+                                             frag.output_kind,
+                                             rebalancer=rebalancer))
         planner.pipelines.append(PhysicalPipeline(ops))
         for p in planner.pipelines:
             if streaming:

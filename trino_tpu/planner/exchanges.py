@@ -51,13 +51,15 @@ def state_types_for(agg: "Aggregation") -> List[T.Type]:  # noqa: F821
 class ExchangePlanner:
     def __init__(self, metadata: Metadata, allocator: SymbolAllocator,
                  broadcast_threshold: float = BROADCAST_THRESHOLD,
-                 join_distribution: str = "AUTOMATIC"):
+                 join_distribution: str = "AUTOMATIC",
+                 scale_writers: bool = False):
         from .stats import StatsCalculator
 
         self.metadata = metadata
         self.allocator = allocator
         self.broadcast_threshold = broadcast_threshold
         self.join_distribution = join_distribution
+        self.scale_writers = scale_writers
         self._stats = StatsCalculator(metadata)
 
     def run(self, root: OutputNode) -> OutputNode:
@@ -282,6 +284,18 @@ class ExchangePlanner:
         from .plan import TableWriterNode
 
         src, dist = self.visit(node.source)
+        if self.scale_writers and dist not in (SINGLE, ANY) \
+                and src.output_symbols:
+            # scaled writers: repartition rows to the writer tasks
+            # through a REBALANCING hash boundary — the leading output
+            # column stands in for the connector's partition columns
+            # (this engine's tables carry none), and the exchanger
+            # re-assigns hot logical partitions across writer lanes by
+            # observed load (reference: SCALED_WRITER_HASH_DISTRIBUTION
+            # in AddExchanges + ScaleWriterPartitioningExchanger)
+            keys = [src.output_symbols[0]]
+            src = ExchangeNode(src, "hash", keys, scale_writers=True)
+            dist = _hash(keys)
         writer = TableWriterNode(src, node.catalog, node.schema,
                                  node.table_name, node.columns,
                                  node.rows_symbol, node.create)
@@ -311,6 +325,7 @@ class ExchangePlanner:
 def add_exchanges(root: OutputNode, metadata: Metadata,
                   allocator: SymbolAllocator,
                   broadcast_threshold: float = BROADCAST_THRESHOLD,
-                  join_distribution: str = "AUTOMATIC") -> OutputNode:
+                  join_distribution: str = "AUTOMATIC",
+                  scale_writers: bool = False) -> OutputNode:
     return ExchangePlanner(metadata, allocator, broadcast_threshold,
-                           join_distribution).run(root)
+                           join_distribution, scale_writers).run(root)

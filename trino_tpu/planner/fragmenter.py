@@ -29,6 +29,9 @@ class PlanFragment:
     output_keys: List[Symbol]
     # fragments this one reads via RemoteSourceNodes
     inputs: List[int] = field(default_factory=list)
+    #: scaled-writer hash boundary: the output exchanger re-assigns
+    #: logical partitions to consumer (writer) lanes by observed load
+    scale_writers: bool = False
 
     @property
     def output_symbols(self) -> List[Symbol]:
@@ -53,7 +56,9 @@ class Fragmenter:
             child_body, child_inputs = self._cut(node.source)
             frag = PlanFragment(len(self.fragments), child_body,
                                 self._driving(child_body), node.kind,
-                                list(node.keys), child_inputs)
+                                list(node.keys), child_inputs,
+                                scale_writers=getattr(
+                                    node, "scale_writers", False))
             self.fragments.append(frag)
             remote = RemoteSourceNode(frag.fragment_id,
                                       list(node.output_symbols), node.kind,

@@ -401,6 +401,11 @@ class ExchangeNode(PlanNode):
     kind: str
     keys: List[Symbol]
     orderings: Optional[List[Ordering]] = None  # kind == 'merge'
+    #: scaled-writer boundary (kind == 'hash' feeding a TableWriter):
+    #: the host exchanger may re-assign logical partitions to writer
+    #: lanes by observed load (reference: the SCALED_WRITER_HASH_
+    #: DISTRIBUTION PartitioningHandle flag on PartitioningScheme)
+    scale_writers: bool = False
 
     @property
     def sources(self):

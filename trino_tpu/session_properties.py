@@ -72,12 +72,14 @@ register(SessionProperty(
     "overflow (0 = spill straight to disk)",
     lambda v: v >= 0))
 register(SessionProperty(
-    "node_max_memory_bytes", "integer", 16 << 30,
+    "node_max_memory_bytes", "integer", 0,
     "Worker-wide memory pool shared by ALL concurrent queries on a "
     "node; over-budget reservations revoke across queries largest-"
     "first, then fail with EXCEEDED_NODE_MEMORY (reference: the "
-    "per-node general MemoryPool)",
-    lambda v: v > 0))
+    "per-node general MemoryPool). 0 = auto: derive from the device's "
+    "reported memory stats (exec.memory.default_node_memory_bytes), "
+    "falling back to 16 GiB where the backend reports none",
+    lambda v: v >= 0))
 register(SessionProperty(
     "query_max_total_memory", "integer", 0,
     "Cluster-wide cap on one query's total reservation summed over all "
@@ -219,6 +221,30 @@ register(SessionProperty(
     "Run hash exchanges between co-resident stages as an all_to_all "
     "device collective over the mesh (falls back to the host path when "
     "tasks outnumber devices or types are host-only)"))
+register(SessionProperty(
+    "hot_partition_split_threshold", "double", 0.5,
+    "Hot-partition SPLITTING in the device-collective exchange: a "
+    "partition holding more than this fraction of the exchange's rows "
+    "is re-bucketed across all receiver devices (row-index-derived "
+    "sub-bucket salt inside the jit'd program; the consumer gather "
+    "re-merges by carried partition id). 1.0 disables splitting "
+    "(reference: ScaleWriterPartitioningExchanger's skewed-partition "
+    "scaling, applied to the receive side)",
+    lambda v: 0 < v <= 1))
+register(SessionProperty(
+    "scale_writers_enabled", "boolean", False,
+    "Scaled writers: INSERT/CTAS plans repartition rows to writer "
+    "tasks through a rebalancing exchange — logical partitions are "
+    "re-assigned to writer lanes from observed row counts "
+    "(EWMA-smoothed with hysteresis), so one hot partition no longer "
+    "serializes the write behind a single writer (reference: "
+    "ScaleWriterPartitioningExchanger + UniformPartitionRebalancer)"))
+register(SessionProperty(
+    "rebalance_min_collectives", "integer", 2,
+    "Scaled-writer hysteresis: the rebalancer changes partition->"
+    "writer-lane assignments at most once per this many observed "
+    "collectives/pages, so assignments cannot flap on bursty input",
+    lambda v: v >= 1))
 register(SessionProperty(
     "device_exchange_sizing", "varchar", "history",
     "How the device collective picks its all_to_all lane capacity "
