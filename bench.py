@@ -44,7 +44,14 @@ line with spill/kill counters, rc=5 on mismatch); BENCH_ROLE=skew
 (adversarial-skew smoke: zipf-keyed device exchange with
 hot-partition splitting vs the unsplit oracle + scaled-writer CTAS
 vs the unscaled oracle, SKEW_RESULT line with split/rebalance
-counters and rows/s, rc=6 on mismatch).
+counters and rows/s, rc=6 on mismatch); BENCH_ROLE=trace / BENCH_TRACE=1
+(distributed-tracing smoke: 2-worker ProcessQueryRunner join with
+query tracing, writes the Perfetto-loadable Chrome-trace artifact to
+BENCH_TRACE_PATH [default ./BENCH_TRACE.json], emits a
+trace_stage_overlap metric line + TRACE_RESULT, rc=7 on a
+disconnected/empty trace tree). Every rate line carries
+backend/device_kind provenance so a CPU fallback can never masquerade
+as a TPU number.
 """
 
 import json
@@ -379,6 +386,59 @@ def _skew_smoke() -> dict:
     return out
 
 
+def _trace_smoke() -> dict:
+    """BENCH_ROLE=trace (BENCH_TRACE=1): run a distributed join under
+    ProcessQueryRunner with tracing on, write the Perfetto-loadable
+    Chrome-trace artifact next to BENCH_*.json, and report the
+    stage_overlap fraction from the span timelines — the metric the
+    streaming-pipeline ROADMAP item will ratchet. rc=7 when the trace
+    tree is disconnected (orphan spans) or empty."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from trino_tpu.parallel.process_runner import ProcessQueryRunner
+    from trino_tpu.sql.analyzer import Session
+    from trino_tpu.telemetry.tracing import (span_tree, stage_overlap,
+                                             to_chrome_trace)
+
+    sql = ("select c.c_custkey, o.o_orderkey from customer c "
+           "join orders o on c.c_custkey = o.o_custkey "
+           "where c.c_mktsegment = 'BUILDING' "
+           "order by o.o_orderkey limit 10")
+    t0 = time.time()
+    with ProcessQueryRunner(
+            {"tpch": {"connector": "tpch", "page_rows": 4096}},
+            Session(catalog="tpch", schema="micro"),
+            n_workers=2, desired_splits=4,
+            broadcast_threshold=300.0) as c:
+        res = c.execute(sql)
+    spans = (res.stats or {}).get("trace") or []
+    roots, _children, orphans = span_tree(spans)
+    artifact = os.environ.get("BENCH_TRACE_PATH",
+                              os.path.join(REPO, "BENCH_TRACE.json"))
+    with open(artifact, "w") as f:
+        json.dump(to_chrome_trace(spans), f)
+    overlap = stage_overlap(spans)
+    workers = {s["process"] for s in spans
+               if s["process"].startswith("worker")}
+    out = {
+        "ok": bool(spans) and len(roots) == 1 and not orphans
+        and len(workers) >= 2,
+        "spans": len(spans), "orphans": len(orphans),
+        "worker_lanes": len(workers),
+        "stage_overlap": round(overlap, 4),
+        "artifact": artifact,
+        "wall_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps({
+        "metric": "trace_stage_overlap", "value": out["stage_overlap"],
+        "unit": "fraction", "vs_baseline": 0.0,
+        "spans": out["spans"], "artifact": artifact,
+    }), flush=True)
+    print("TRACE_RESULT " + json.dumps(out), flush=True)
+    if not out["ok"]:
+        raise SystemExit(7)
+    return out
+
+
 # ---------------------------------------------------------------- parent ----
 
 def _guarded_child_cls():
@@ -448,11 +508,18 @@ def _emit(state, res, suffix, base, cached_base=False):
             "jit_traces": res.get("jit_traces"), **extra,
         }), flush=True)
     ratio = round(res["rate"] / base, 3) if base else 0.0
+    device = res.get("device", "")
     line = json.dumps({
         "metric": f"tpch_{q}_{res['schema']}_rows_per_sec{suffix}",
         "value": round(res["rate"], 1),
         "unit": "rows/s",
         "vs_baseline": ratio,
+        # provenance stamp: a CPU-fallback run can never masquerade as
+        # a TPU number — the backend that actually ran is in the line,
+        # not only in the metric suffix
+        "backend": "tpu" if device and "cpu" not in device.lower()
+        else "cpu",
+        "device_kind": device,
     })
     state["line"] = line
     if q == "q3":
@@ -520,13 +587,39 @@ def main():
         # it is a sound (if unpersisted) baseline for the ratio
         solo_base[res.get("query", "q1")] = res["rate"]
 
+    # Optional trace phase (BENCH_TRACE=1): a guarded child runs the
+    # distributed-trace smoke, its stage_overlap metric line re-emits
+    # here, and the Perfetto artifact lands next to BENCH_*.json.
+    # Before phase 2 so the q3 headline stays the LAST stdout line.
+    if os.environ.get("BENCH_TRACE") == "1":
+        env = dict(os.environ, BENCH_ROLE="trace")
+        tracer = _guarded_child_cls()(
+            [sys.executable, "-u", os.path.abspath(__file__)],
+            env=env, tag="bench-trace")
+        state["children"] = [tracer]
+        trace_deadline = min(t_start + deadline - 60, time.time() + 150)
+        while time.time() < trace_deadline and not tracer.exited():
+            time.sleep(0.5)
+        trace_text = tracer.kill()
+        for line in trace_text.splitlines():
+            if line.startswith('{"metric": "trace_stage_overlap"'):
+                print(line, flush=True)
+        sys.stderr.write(f"bench: trace child tail:\n"
+                         f"{trace_text[-600:]}\n")
+
     # Phase 2: TPU child SOLO — the per-chip rate must not be measured under
-    # host CPU contention from the baseline child. One respawn on an early
-    # crash (transient chip lock, the round-1 mode).
+    # host CPU contention from the baseline child. Bounded retry with
+    # exponential backoff around backend init: the rc=3 watchdog inside
+    # the child fails fast when the axon tunnel hangs `import jax`, and
+    # a tunnel that is down NOW is often back in 10-30 s — retrying with
+    # backoff while the budget lasts is how a flaky tunnel still yields
+    # a real TPU number instead of a silent CPU-only run.
     tpu_deadline = t_start + max(60.0, min(tpu_budget, deadline - 30))
+    max_attempts = max(1, int(os.environ.get("BENCH_INIT_RETRIES", "3")))
+    backoff = 5.0
     tpu_results = []
     tpu_text = ""
-    for attempt in range(2):
+    for attempt in range(max_attempts):
         if time.time() >= tpu_deadline - 30:
             break
         tpu = _spawn("default")
@@ -534,14 +627,20 @@ def main():
         while time.time() < tpu_deadline and not tpu.exited():
             time.sleep(0.5)
         crashed_early = tpu.exited()
+        rc = tpu.proc.returncode
         tpu_text = tpu.kill()
         # a killed child may still have written RESULTs before hanging
         tpu_results = _parse_results(tpu_text)
-        sys.stderr.write(f"bench: tpu child (attempt {attempt + 1}) "
-                         f"tail:\n{tpu_text[-1500:]}\n")
-        if tpu_results or not crashed_early:
-            break  # success, or a hang (retrying a hang wastes the budget)
-        time.sleep(5)
+        sys.stderr.write(f"bench: tpu child (attempt {attempt + 1}, "
+                         f"rc={rc}) tail:\n{tpu_text[-1500:]}\n")
+        if tpu_results:
+            break
+        if not crashed_early and rc != 3:
+            break  # a hang was killed at deadline: retrying wastes budget
+        # rc=3 (init watchdog) or an early crash (transient chip lock):
+        # back off, then respawn while budget remains
+        time.sleep(min(backoff, max(0.0, tpu_deadline - time.time())))
+        backoff *= 2
 
     for res in tpu_results:
         q = res.get("query", "q1")
@@ -597,5 +696,7 @@ if __name__ == "__main__":
         _memory_smoke()
     elif os.environ.get("BENCH_ROLE") == "skew":
         _skew_smoke()
+    elif os.environ.get("BENCH_ROLE") == "trace":
+        _trace_smoke()
     else:
         main()

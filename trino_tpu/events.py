@@ -10,8 +10,9 @@ queries) — the reference contract.
 from __future__ import annotations
 
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Deque, Dict, List, Optional
 
 
 @dataclass(frozen=True)
@@ -33,6 +34,10 @@ class QueryCompletedEvent:
     output_rows: int = 0
     error_code: Optional[str] = None
     error_message: Optional[str] = None
+    #: execution statistics payload (reference: the QueryStatistics
+    #: half of QueryCompletedEvent): peak memory, recovery counters,
+    #: wall breakdown — whatever the runner observed, as a plain dict
+    stats: Optional[dict] = None
 
     @property
     def wall_ms(self) -> float:
@@ -94,13 +99,64 @@ class EventListener:
         pass
 
 
+class QueryHistoryListener(EventListener):
+    """Ring-buffer listener retaining the last N completed queries plus
+    the currently-running set (reference: QueryTracker's history kept
+    for ``/v1/query`` + ``system.runtime.queries``).  A lock guards
+    both sides: readers snapshot while protocol-server executor
+    threads complete queries concurrently (iterating a live deque/dict
+    would raise RuntimeError mid-scrape)."""
+
+    def __init__(self, capacity: int = 256):
+        import threading
+
+        self._lock = threading.Lock()
+        self.completed: Deque[QueryCompletedEvent] = deque(
+            maxlen=capacity)
+        self.running: Dict[str, QueryCreatedEvent] = {}
+
+    def query_created(self, event: QueryCreatedEvent):
+        with self._lock:
+            self.running[event.query_id] = event
+
+    def query_completed(self, event: QueryCompletedEvent):
+        with self._lock:
+            self.running.pop(event.query_id, None)
+            self.completed.append(event)
+
+    def snapshot_completed(self) -> List[QueryCompletedEvent]:
+        with self._lock:
+            return list(self.completed)
+
+    def snapshot_running(self) -> List[QueryCreatedEvent]:
+        with self._lock:
+            return list(self.running.values())
+
+
 @dataclass
 class EventListenerManager:
     listeners: List[EventListener] = field(default_factory=list)
     _counter: int = 0
+    history_capacity: int = 256
+
+    def __post_init__(self):
+        # the built-in ring buffer backs system.runtime.queries and
+        # /v1/query/{id}; user listeners ride alongside it
+        self.history_listener = QueryHistoryListener(
+            self.history_capacity)
+        self.listeners = list(self.listeners) + [self.history_listener]
 
     def add(self, listener: EventListener):
         self.listeners.append(listener)
+
+    def history(self, n: int = 100) -> List[QueryCompletedEvent]:
+        """The most recent completed-query events, oldest first."""
+        return self.history_listener.snapshot_completed()[-n:]
+
+    def running(self) -> List[QueryCreatedEvent]:
+        """Currently-executing queries (created, not yet completed)."""
+        return sorted(self.history_listener.snapshot_running(),
+                      key=lambda e: e.create_time)
 
     def next_query_id(self) -> str:
         self._counter += 1
@@ -158,10 +214,10 @@ class QueryMonitor:
         self.manager.fire_created(QueryCreatedEvent(
             self.query_id, self.user, self.sql, self.create_time))
 
-    def completed(self, output_rows: int):
+    def completed(self, output_rows: int, stats: Optional[dict] = None):
         self.manager.fire_completed(QueryCompletedEvent(
             self.query_id, self.user, self.sql, self.create_time,
-            time.time(), "FINISHED", output_rows))
+            time.time(), "FINISHED", output_rows, stats=stats))
 
     def failed(self, error: Exception):
         self.manager.fire_completed(QueryCompletedEvent(

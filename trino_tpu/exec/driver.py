@@ -31,6 +31,11 @@ class OperatorStats:
     output_pages: int = 0
     wall_ns: int = 0
     compile_count: int = 0
+    #: perf_counter_ns of this operator's first/last active quantum —
+    #: with the driver's ``epoch_anchor`` these place the operator on a
+    #: cross-process trace timeline (telemetry.tracing.add_driver_spans)
+    first_ns: int = 0
+    last_ns: int = 0
     #: operator-reported metrics (exchange skew stats etc.), pulled from
     #: ``op.metrics()`` once the driver finishes — the OperatorStats
     #: analog of the reference's per-operator Metrics map
@@ -71,6 +76,10 @@ class Driver:
         self.last_moved = False
         self.stats: List[OperatorStats] = [
             OperatorStats(type(op).__name__) for op in operators]
+        #: (epoch seconds, perf_counter_ns) at driver creation: converts
+        #: the stats' first_ns/last_ns to wall-clock span timestamps
+        self.epoch_anchor = (time.time(), time.perf_counter_ns()) \
+            if collect_stats else None
 
     @property
     def source(self) -> Optional[SourceOperator]:
@@ -87,6 +96,23 @@ class Driver:
         if src is not None:
             src.no_more_splits()
 
+    def _timed_call(self, idx: int, fn):
+        """Run one operator call attributing wall/compiles/activity to
+        stats[idx] — the same attribution the page-move hot path does
+        inline (finish propagation and tail drains can do real work:
+        an aggregation's finish builds its output state)."""
+        t0 = time.perf_counter_ns()
+        c0 = jit_stats.thread_total()
+        out = fn()
+        t1 = time.perf_counter_ns()
+        st = self.stats[idx]
+        st.wall_ns += t1 - t0
+        st.compile_count += jit_stats.thread_total() - c0
+        if st.first_ns == 0:
+            st.first_ns = t0
+        st.last_ns = t1
+        return out
+
     def process(self) -> bool:
         """One scheduling quantum: move pages between adjacent operators.
         Returns True if the driver is fully finished."""
@@ -96,15 +122,22 @@ class Driver:
             cur, nxt = ops[i], ops[i + 1]
             # finish propagation
             if cur.is_finished() and not nxt._finishing:
-                nxt.finish()
+                if self.collect_stats:
+                    self._timed_call(i + 1, nxt.finish)
+                else:
+                    nxt.finish()
             if nxt.needs_input():
                 if self.collect_stats:
                     t0 = time.perf_counter_ns()
                     c0 = jit_stats.thread_total()
                     page = cur.get_output()
+                    t1 = time.perf_counter_ns()
                     st = self.stats[i]
-                    st.wall_ns += time.perf_counter_ns() - t0
+                    st.wall_ns += t1 - t0
                     st.compile_count += jit_stats.thread_total() - c0
+                    if st.first_ns == 0:
+                        st.first_ns = t0
+                    st.last_ns = t1
                     if page is not None:
                         st.output_pages += 1
                         st.output_rows += page.count()
@@ -115,18 +148,28 @@ class Driver:
                         t0 = time.perf_counter_ns()
                         c0 = jit_stats.thread_total()
                         nxt.add_input(page)
+                        t1 = time.perf_counter_ns()
                         st1 = self.stats[i + 1]
-                        st1.wall_ns += time.perf_counter_ns() - t0
+                        st1.wall_ns += t1 - t0
                         st1.compile_count += jit_stats.thread_total() - c0
+                        if st1.first_ns == 0:
+                            st1.first_ns = t0
+                        st1.last_ns = t1
                     else:
                         nxt.add_input(page)
                     moved = True
         # drain the tail operator (sinks produce no output)
-        ops[-1].get_output()
+        if self.collect_stats:
+            self._timed_call(len(ops) - 1, ops[-1].get_output)
+        else:
+            ops[-1].get_output()
         if not moved:
             # nothing moved: push finish from the head if it is done
             if ops[0].is_finished() and not ops[0]._finishing:
-                ops[0].finish()
+                if self.collect_stats:
+                    self._timed_call(0, ops[0].finish)
+                else:
+                    ops[0].finish()
         self.last_moved = moved
         return ops[-1].is_finished()
 

@@ -115,6 +115,11 @@ class QueryStatsTree:
     #: replaced, speculative launches/wins — attached by the process
     #: runner so EXPLAIN ANALYZE and the bench surface recovery
     recovery: Optional[Dict] = None
+    #: finished distributed-trace spans (telemetry.tracing dicts):
+    #: coordinator root/plan/fragment/attempt spans + the worker
+    #: task/operator spans piggybacked on task responses — the timeline
+    #: the Chrome-trace export and the Trace: line render
+    trace: Optional[List[dict]] = None
 
     def to_dict(self) -> dict:
         return {
@@ -122,8 +127,18 @@ class QueryStatsTree:
             "memory": self.memory,
             "cluster_memory": self.cluster_memory,
             "recovery": self.recovery,
+            "trace": self.trace,
             "stages": [s.to_dict() for s in self.stages],
         }
+
+    def trace_line(self) -> Optional[str]:
+        """One EXPLAIN ANALYZE line: span count + the critical path
+        through the assembled trace tree; None when tracing was off."""
+        if not self.trace:
+            return None
+        from ..telemetry.tracing import trace_line
+
+        return trace_line(self.trace)
 
     def cluster_memory_line(self) -> Optional[str]:
         """One EXPLAIN ANALYZE line for the cluster memory view; None
@@ -184,6 +199,9 @@ class QueryStatsTree:
         rec_line = self.recovery_line()
         if rec_line:
             lines.append(rec_line)
+        tr_line = self.trace_line()
+        if tr_line:
+            lines.append(tr_line)
         for s in sorted(self.stages, key=lambda s: -s.stage_id):
             total_rows = sum(t.output_rows for t in s.tasks)
             lines.append(

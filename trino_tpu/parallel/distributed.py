@@ -45,6 +45,14 @@ class DistributedQueryRunner:
                  broadcast_threshold: Optional[float] = None):
         from .. import session_properties as SP
 
+        connectors = dict(connectors)
+        if "system" not in connectors:
+            # in-process workers share this runner's memory, so system
+            # tables work without the coordinator-routing the
+            # multi-process runner needs
+            from ..connectors.system import SystemConnector
+
+            connectors["system"] = SystemConnector(source=self)
         self.metadata = Metadata(connectors)
         self.session = session or Session(
             catalog=next(iter(connectors), None))
@@ -56,6 +64,13 @@ class DistributedQueryRunner:
             else SP.value(self.session, "broadcast_join_threshold")
 
     # ------------------------------------------------------------------
+
+    def metrics_families(self) -> list:
+        """system.runtime.metrics source: the in-process runner exports
+        the process-level families (jit traces, exchange counters)."""
+        from ..telemetry.metrics import process_families
+
+        return process_families()
 
     def create_fragments(self, sql_or_stmt) -> List[PlanFragment]:
         stmt = sql_or_stmt if isinstance(sql_or_stmt, ast.Statement) \

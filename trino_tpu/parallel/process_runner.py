@@ -76,7 +76,7 @@ from .. import session_properties as SP
 from .. import types as T
 from ..block import Page
 from ..events import (EventListenerManager, MemoryKillEvent,
-                      TaskRetryEvent, WorkerReplacedEvent)
+                      QueryMonitor, TaskRetryEvent, WorkerReplacedEvent)
 from ..exec.serde import PageDeserializer, PageSerializer
 from ..exec.stats import QueryStatsTree
 from ..planner.fragmenter import PlanFragment
@@ -84,13 +84,16 @@ from ..runner import QueryResult
 from ..sql import ast
 from ..sql.analyzer import Session
 from ..sql.parser import parse_statement
+from ..telemetry.metrics import ClusterMetrics
+from ..telemetry.tracing import (NULL_SPAN, NULL_TRACER, Tracer,
+                                 add_driver_spans)
 from ..types import TrinoError
 from .cluster_memory import ClusterMemoryManager
 from .fault import (EXTERNAL, INSUFFICIENT_RESOURCES, INTERNAL, USER,
                     BackoffPolicy, Deadline, DecayingFailureStats,
                     FaultSchedule, RecoveryStats, RemoteTaskError,
                     classify_error_code)
-from .rpc import call, fetch_pages, recv_msg, send_msg
+from .rpc import call, fetch_pages, recv_msg, send_msg, with_trace
 
 
 class WorkerHandle:
@@ -148,6 +151,13 @@ class _QueryCtx:
         #: the escalation path after an INSUFFICIENT_RESOURCES failure
         self.session_overrides: Dict[str, object] = {}
         self.task_width: Optional[int] = None
+        #: distributed-trace state (telemetry.tracing): the per-query
+        #: tracer plus the root and current-attempt spans fragment/task
+        #: spans parent to; the shared no-op defaults make every span
+        #: site zero-cost when query_tracing_enabled is off
+        self.tracer = NULL_TRACER
+        self.root_span = NULL_SPAN
+        self.attempt_span = NULL_SPAN
 
     def timeout(self, base: Optional[float] = None) -> float:
         """RPC timeout capped by the query deadline (raises
@@ -248,6 +258,18 @@ class ProcessQueryRunner:
         self.cluster_memory = ClusterMemoryManager(
             SP.value(self.session, "memory_killer_policy"),
             SP.value(self.session, "query_max_total_memory"))
+        #: coordinator-side aggregation of the metric snapshots each
+        #: heartbeat ping piggybacks (served on GET /v1/metrics and
+        #: system.runtime.metrics)
+        self.cluster_metrics = ClusterMetrics()
+        # the system catalog serves this coordinator's live state as
+        # SQL tables (system.runtime.*); it stays coordinator-local —
+        # worker processes never see it in catalog_config
+        if "system" not in self.connectors:
+            from ..connectors.system import SystemConnector
+
+            self.connectors["system"] = SystemConnector(source=self)
+            self.metadata = Metadata(self.connectors)
         self.worker_replacement = worker_replacement
         self.heartbeat_interval = heartbeat_interval
         self._heal_lock = threading.Lock()
@@ -427,11 +449,12 @@ class ProcessQueryRunner:
         ClusterMemoryManager (no extra RPC)."""
         ok = []
         for i, w in enumerate(self.workers):
-            memory = None
+            memory = metrics = None
             try:
                 resp = w.rpc({"op": "ping"}, timeout=10)
                 alive = bool(resp.get("ok"))
                 memory = resp.get("memory")
+                metrics = resp.get("metrics")
             except OSError:
                 alive = False
             was_alive = w.alive
@@ -440,8 +463,10 @@ class ProcessQueryRunner:
                 w.failure_stats.record()
             if w.alive:
                 self.cluster_memory.update(i, memory)
+                self.cluster_metrics.update(i, metrics)
             else:
                 self.cluster_memory.forget_worker(i)
+                self.cluster_metrics.forget(i)
             ok.append(w.alive)
         return ok
 
@@ -592,7 +617,44 @@ class ProcessQueryRunner:
     # -- statement routing -----------------------------------------------
 
     def execute(self, sql: str) -> QueryResult:
+        """Statement routing wrapped in query lifecycle events
+        (reference: DispatchManager + QueryMonitor): created/completed
+        events feed the ring-buffer history that backs
+        ``system.runtime.queries`` and ``/v1/query/{id}``, with the
+        completed event carrying a stats payload (peak memory, recovery
+        counters, wall breakdown — the QueryStatistics analog)."""
         stmt = parse_statement(sql)
+        monitor = QueryMonitor(self.event_manager, self.session.user,
+                               sql)
+        monitor.created()
+        t0 = time.perf_counter()
+        try:
+            res = self._route_statement(stmt, sql)
+        except Exception as e:
+            monitor.failed(e)
+            raise
+        monitor.completed(len(res.rows),
+                          stats=self._event_stats(res, t0))
+        return res
+
+    def _route_statement(self, stmt, sql: str) -> QueryResult:
+        if self._touches_system(stmt):
+            # system.runtime tables are views over THIS coordinator's
+            # live state: any statement reading them — plain SELECT,
+            # EXPLAIN ANALYZE, INSERT ... SELECT, CTAS — executes here,
+            # never as worker fragments (workers build connectors from
+            # catalog_config, which never carries the system catalog;
+            # the reference pins system-table splits to the coordinator
+            # node). Writes sourced from system tables still replicate.
+            from ..runner import LocalQueryRunner
+
+            res = LocalQueryRunner(self.connectors,
+                                   self.session).execute(sql)
+            if isinstance(stmt, (ast.Insert, ast.CreateTableAsSelect)):
+                self._sync_written(stmt)
+            else:
+                self._sync_after_local(stmt)
+            return res
         if isinstance(stmt, ast.Explain) and stmt.analyze and \
                 isinstance(stmt.statement, ast.QueryStatement):
             return self._explain_analyze(stmt.statement)
@@ -611,6 +673,49 @@ class ProcessQueryRunner:
         self._sync_after_local(stmt)
         return res
 
+    def _touches_system(self, stmt) -> bool:
+        """Does any table reference of this statement resolve into the
+        coordinator-local system catalog? Generic AST walk: table nodes
+        can sit under joins, subqueries, and set operations."""
+        import dataclasses
+
+        def walk(node) -> bool:
+            if isinstance(node, ast.Table):
+                resolved = self.metadata.resolve_table(
+                    node.name, self.session)
+                return resolved is not None and resolved[0] == "system"
+            if dataclasses.is_dataclass(node) and \
+                    not isinstance(node, type):
+                return any(walk(getattr(node, f.name))
+                           for f in dataclasses.fields(node))
+            if isinstance(node, (tuple, list)):
+                return any(walk(x) for x in node)
+            return False
+
+        return walk(stmt)
+
+    @staticmethod
+    def _event_stats(res: QueryResult, t0: float) -> dict:
+        """The QueryCompletedEvent stats payload (reference:
+        QueryStatistics): peak memory, recovery counters, and a
+        coordinator wall breakdown derived from the trace spans."""
+        stats = res.stats or {}
+        breakdown: Dict[str, float] = {}
+        for s in stats.get("trace") or ():
+            if s.get("process") == "coordinator":
+                name = s["name"].split(" ")[0]
+                breakdown[name] = round(
+                    breakdown.get(name, 0.0)
+                    + (s["end"] - s["start"]) * 1e3, 2)
+        return {
+            "wall_ms": round((time.perf_counter() - t0) * 1e3, 2),
+            "peak_memory_bytes":
+                (stats.get("memory") or {}).get("peak_bytes", 0),
+            "recovery": stats.get("recovery"),
+            "cluster_memory": stats.get("cluster_memory"),
+            "wall_breakdown": breakdown or None,
+        }
+
     def _explain_analyze(self, stmt) -> QueryResult:
         """Distributed EXPLAIN ANALYZE: run the query through the full
         retry machinery and render wall time + recovery counters
@@ -622,7 +727,8 @@ class ProcessQueryRunner:
             wall_ms=(time.perf_counter() - t0) * 1e3,
             memory=(res.stats or {}).get("memory"),
             cluster_memory=(res.stats or {}).get("cluster_memory"),
-            recovery=(res.stats or {}).get("recovery"))
+            recovery=(res.stats or {}).get("recovery"),
+            trace=(res.stats or {}).get("trace"))
         lines = tree.render()
         lines.append(f"Output: {len(res.rows)} rows")
         return QueryResult(["Query Plan"], [T.VARCHAR],
@@ -655,8 +761,17 @@ class ProcessQueryRunner:
 
     def _execute_with_retry(self, stmt) -> QueryResult:
         ctx = _QueryCtx(self.session, f"q{self._task_seq + 1}")
+        if SP.value(self.session, "query_tracing_enabled"):
+            ctx.tracer = Tracer(process="coordinator")
         try:
-            return self._retry_loop(stmt, ctx)
+            with ctx.tracer.span(
+                    "query", statement=type(stmt).__name__) as root:
+                ctx.root_span = root
+                res = self._retry_loop(stmt, ctx)
+            if ctx.tracer.enabled:
+                res.stats = dict(res.stats or {},
+                                 trace=ctx.tracer.finished())
+            return res
         finally:
             self.recovery_total.merge(ctx.recovery)
 
@@ -778,14 +893,19 @@ class ProcessQueryRunner:
         return fragments, planning._root
 
     def _execute_once(self, stmt, qid: str, ctx: _QueryCtx) -> QueryResult:
-        fragments, root = self._plan(stmt)
-        # TASK retry requires durable stage outputs, i.e. the spooled
-        # barrier shape — the reference's fault-tolerant execution also
-        # forgoes streaming pipelining under RetryPolicy.TASK
-        if SP.value(self.session, "retry_policy") != "TASK" and \
-                SP.value(self.session, "streaming_execution"):
-            return self._execute_streaming(qid, fragments, root, ctx)
-        return self._execute_barrier(qid, fragments, root, ctx)
+        with ctx.tracer.span(f"execute {qid}", parent=ctx.root_span,
+                             qid=qid) as attempt_span:
+            ctx.attempt_span = attempt_span
+            with ctx.tracer.span("plan", parent=attempt_span):
+                fragments, root = self._plan(stmt)
+            # TASK retry requires durable stage outputs, i.e. the
+            # spooled barrier shape — the reference's fault-tolerant
+            # execution also forgoes streaming pipelining under
+            # RetryPolicy.TASK
+            if SP.value(self.session, "retry_policy") != "TASK" and \
+                    SP.value(self.session, "streaming_execution"):
+                return self._execute_streaming(qid, fragments, root, ctx)
+            return self._execute_barrier(qid, fragments, root, ctx)
 
     # ----------------------------------------------- streaming mode ----
 
@@ -810,7 +930,7 @@ class ProcessQueryRunner:
                     locations[frag.fragment_id] = self._start_fragment(
                         qid, frag, live, dict(locations), query_tasks,
                         bound, ctx)
-            overlap = self._collect_overlap(query_tasks)
+            overlap = self._collect_overlap(query_tasks, ctx)
         finally:
             self._release(query_tasks)
         rows: List[tuple] = []
@@ -833,38 +953,54 @@ class ProcessQueryRunner:
         ntasks = 1 if frag.partitioning == "single" else width
         placeable = prefer_healthy(live)
         results = []
-        for t in range(ntasks):
-            task_id = f"{qid}.f{frag.fragment_id}.t{t}.s"
-            self.task_launches.append(task_id)
-            ctx.recovery.incr("task_attempts")
-            worker = placeable[t % len(placeable)]
-            req = {
-                "op": "run_task", "task_id": task_id,
-                "fragment": frag, "task_index": t,
-                "task_count": ntasks,
-                "n_partitions": width,
-                "output_kind": frag.output_kind,
-                "upstream": upstream,
-                "desired_splits": self.desired_splits,
-                "session": self._session_for(ctx),
-                "streaming": True, "buffer_bound": bound,
-                "coordinator": self.service.addr,
-                "remote_write_catalogs": sorted(self._replicated),
-                "fault": self.fault_schedule.match(task_id),
-            }
-            try:
-                # full rpc_request_timeout: the streaming ack is fast on
-                # a healthy worker, and the property must be able to
-                # RAISE the bound on slow hosts, not only lower it
-                resp = worker.rpc(req, timeout=ctx.timeout())
-            except OSError:
-                worker.alive = False
-                worker.failure_stats.record()
-                raise _WorkerLost(f"worker {worker.addr} unreachable")
-            if not resp.get("ok"):
-                raise self._task_error(resp, task_id)
-            results.append((worker.addr, task_id))
-            query_tasks.append((worker.addr, task_id))
+        # the streaming fragment span covers scheduling (the launch
+        # RPCs); the tasks' own run time shows up in the worker task
+        # spans collected at query end via task_status
+        with ctx.tracer.span(f"fragment f{frag.fragment_id}",
+                             parent=ctx.attempt_span,
+                             fragment=frag.fragment_id) as frag_span:
+            for t in range(ntasks):
+                task_id = f"{qid}.f{frag.fragment_id}.t{t}.s"
+                self.task_launches.append(task_id)
+                ctx.recovery.incr("task_attempts")
+                worker = placeable[t % len(placeable)]
+                launch_span = ctx.tracer.span(
+                    f"launch {task_id}", parent=frag_span,
+                    task_id=task_id, attempt=0, span_kind="attempt",
+                    fragment=frag.fragment_id)
+                req = with_trace({
+                    "op": "run_task", "task_id": task_id,
+                    "fragment": frag, "task_index": t,
+                    "task_count": ntasks,
+                    "n_partitions": width,
+                    "output_kind": frag.output_kind,
+                    "upstream": upstream,
+                    "desired_splits": self.desired_splits,
+                    "session": self._session_for(ctx),
+                    "streaming": True, "buffer_bound": bound,
+                    "coordinator": self.service.addr,
+                    "remote_write_catalogs": sorted(self._replicated),
+                    "fault": self.fault_schedule.match(task_id),
+                }, launch_span, attempt=0)
+                try:
+                    # full rpc_request_timeout: the streaming ack is
+                    # fast on a healthy worker, and the property must be
+                    # able to RAISE the bound on slow hosts, not only
+                    # lower it
+                    resp = worker.rpc(req, timeout=ctx.timeout())
+                except OSError:
+                    worker.alive = False
+                    worker.failure_stats.record()
+                    launch_span.set("error_type", EXTERNAL)
+                    launch_span.finish()
+                    raise _WorkerLost(
+                        f"worker {worker.addr} unreachable")
+                launch_span.finish()
+                if not resp.get("ok"):
+                    ctx.tracer.add_finished(resp.get("spans"))
+                    raise self._task_error(resp, task_id)
+                results.append((worker.addr, task_id))
+                query_tasks.append((worker.addr, task_id))
         return {"kind": frag.output_kind, "locations": results}
 
     @staticmethod
@@ -928,10 +1064,26 @@ class ProcessQueryRunner:
             **grouping_options(self.session.properties))
         abort = threading.Event()
         try:
-            plan = planner.plan(OutputNode(frag.root, root.column_names,
-                                           root.outputs))
-            for p in plan.pipelines:
-                run_driver_blocking(Driver(p.operators), abort)
+            with ctx.tracer.span(
+                    f"fragment f{frag.fragment_id}",
+                    parent=ctx.attempt_span,
+                    fragment=frag.fragment_id) as frag_span:
+                with ctx.tracer.span("plan", parent=frag_span):
+                    plan = planner.plan(OutputNode(
+                        frag.root, root.column_names, root.outputs))
+                with ctx.tracer.span(
+                        f"task output f{frag.fragment_id}",
+                        parent=frag_span, span_kind="task",
+                        fragment=frag.fragment_id,
+                        task_id="output") as task_span:
+                    drivers = []
+                    for p in plan.pipelines:
+                        d = Driver(p.operators,
+                                   collect_stats=ctx.tracer.enabled)
+                        drivers.append(d)
+                        run_driver_blocking(d, abort)
+                for d in drivers:
+                    add_driver_spans(ctx.tracer, d, task_span)
             return plan.sink.pages
         except ExchangeConnectionLost as e:
             raise _WorkerLost(f"output stage pull failed: {e}")
@@ -948,21 +1100,31 @@ class ProcessQueryRunner:
             for ch in channels:
                 ch.close()
 
-    def _collect_overlap(self, query_tasks) -> Dict[str, bool]:
+    def _collect_overlap(self, query_tasks,
+                         ctx: Optional[_QueryCtx] = None
+                         ) -> Dict[str, bool]:
         """Per-task streaming witness: did a cross-process consumer
-        drain this task's first page before the task finished?"""
+        drain this task's first page before the task finished? When
+        tracing, the same poll also collects each task's finished spans
+        (streaming tasks outlive their run_task ack, so their spans
+        cannot ride the launch response)."""
+        want_spans = ctx is not None and ctx.tracer.enabled
         by_worker: Dict[tuple, List[str]] = {}
         for addr, task_id in query_tasks:
             by_worker.setdefault(tuple(addr), []).append(task_id)
         overlap: Dict[str, bool] = {}
         for addr, ids in by_worker.items():
+            req = {"op": "task_status", "task_ids": ids}
+            if want_spans:
+                req["include_spans"] = True
             try:
-                resp = call(addr, {"op": "task_status", "task_ids": ids},
-                            timeout=10)
+                resp = call(addr, req, timeout=10)
             except OSError:
                 continue
             for tid, st in resp.get("statuses", {}).items():
                 overlap[tid] = bool(st.get("overlapped"))
+                if want_spans:
+                    ctx.tracer.add_finished(st.get("spans"))
         return overlap
 
     # ----------------------------------------------- barrier mode ------
@@ -1013,7 +1175,22 @@ class ProcessQueryRunner:
         """One barrier stage: launch every task, retry failed attempts
         on other workers (taxonomy-gated), speculatively re-dispatch
         stragglers when outputs are durable, enforce the query deadline
-        while waiting."""
+        while waiting. The stage runs under a fragment span; every task
+        attempt (first launch, retries, speculative re-dispatches) is a
+        SIBLING attempt span beneath it, failed ones tagged with their
+        fault taxonomy — the tree EXPLAIN ANALYZE's Trace: line and the
+        Chrome-trace export render."""
+        with ctx.tracer.span(f"fragment f{frag.fragment_id}",
+                             parent=ctx.attempt_span,
+                             fragment=frag.fragment_id) as frag_span:
+            return self._run_fragment_tasks(qid, frag, locations,
+                                            query_tasks, spool_mgr, ctx,
+                                            frag_span)
+
+    def _run_fragment_tasks(self, qid: str, frag: PlanFragment,
+                            locations: Dict[int, dict],
+                            query_tasks: List, spool_mgr,
+                            ctx: _QueryCtx, frag_span) -> dict:
         width = ctx.task_width if ctx.task_width is not None \
             else self.n_workers
         ntasks = 1 if frag.partitioning == "single" else width
@@ -1054,14 +1231,36 @@ class ProcessQueryRunner:
             spool makes the losing duplicate harmless)."""
             self.task_launches.append(attempt_id)
             ctx.recovery.incr("task_attempts")
-            req = build_req(t, attempt_id)
+            # attempt identity from the id suffix (.rN / .spec): the
+            # span is tagged so retries and speculative re-dispatches
+            # read as sibling attempts with their taxonomy
+            suffix = attempt_id.rsplit(".", 1)[-1]
+            speculative = suffix == "spec"
+            attempt_no = int(suffix[1:]) if suffix.startswith("r") \
+                and suffix[1:].isdigit() else 0
+            span = ctx.tracer.span(
+                f"attempt {attempt_id}", parent=frag_span,
+                task_id=attempt_id, attempt=attempt_no,
+                speculative=speculative, span_kind="attempt",
+                fragment=frag.fragment_id)
+            req = with_trace(build_req(t, attempt_id), span,
+                             attempt=attempt_no,
+                             speculative=speculative)
             try:
                 resp = worker.rpc(req, timeout=ctx.timeout())
             except OSError:
                 worker.alive = False
                 worker.failure_stats.record()
+                span.set("error", f"worker {worker.addr} lost mid-RPC")
+                span.set("error_type", EXTERNAL)
+                span.finish()
                 return "lost-worker", None
             self._record_peak(attempt_id, resp)
+            ctx.tracer.add_finished(resp.get("spans"))
+            if not resp.get("ok"):
+                span.set("error", resp.get("error"))
+                span.set("error_type", resp.get("error_type", INTERNAL))
+            span.finish()
             if resp.get("ok"):
                 with reg_lock:
                     if results[t] is None and not closed:
@@ -1285,9 +1484,23 @@ class ProcessQueryRunner:
             exchange_reader=exchange_reader,
             **grouping_options(self.session.properties))
         try:
-            plan = planner.plan(OutputNode(frag.root, root.column_names,
-                                           root.outputs))
-            return plan.execute()
+            with ctx.tracer.span(
+                    f"fragment f{frag.fragment_id}",
+                    parent=ctx.attempt_span,
+                    fragment=frag.fragment_id) as frag_span:
+                with ctx.tracer.span("plan", parent=frag_span):
+                    plan = planner.plan(OutputNode(
+                        frag.root, root.column_names, root.outputs))
+                with ctx.tracer.span(
+                        f"task output f{frag.fragment_id}",
+                        parent=frag_span, span_kind="task",
+                        fragment=frag.fragment_id,
+                        task_id="output") as task_span:
+                    pages = plan.execute(
+                        collect_stats=ctx.tracer.enabled)
+                for d in getattr(plan, "drivers", ()):
+                    add_driver_spans(ctx.tracer, d, task_span)
+            return pages
         except RemoteTaskError as e:
             # the taxonomy decides (round-6 satellite: a deterministic
             # execution error must NOT masquerade as a lost worker and
@@ -1312,6 +1525,73 @@ class ProcessQueryRunner:
                      timeout=10)
             except OSError:
                 pass
+
+    # -- observability surface -------------------------------------------
+
+    def metrics_families(self) -> list:
+        """The cluster metrics view: coordinator-process families
+        (recovery, cluster memory, query/worker state, jit/exchange
+        counters) merged with the latest heartbeat-piggybacked worker
+        snapshots — what GET /v1/metrics renders and
+        ``system.runtime.metrics`` serves as rows."""
+        from ..telemetry.metrics import MetricsRegistry, process_families
+
+        reg = MetricsRegistry()
+        rec = self.recovery_total.to_dict()
+        c = reg.counter("trino_recovery_events_total",
+                        "Self-healing counters by kind (task_attempts, "
+                        "retries, worker replacements, speculation, "
+                        "memory escalations)")
+        for kind in ("task_attempts", "task_retries", "query_retries",
+                     "workers_replaced", "speculative_launched",
+                     "speculative_wins", "memory_escalations"):
+            c.inc(rec.get(kind, 0), kind=kind)
+        cm = self.cluster_memory.cluster_stats()
+        g = reg.gauge("trino_cluster_memory_bytes",
+                      "Cluster-wide memory pool state (kind=reserved|"
+                      "max)")
+        g.set(cm.get("total_reserved_bytes", 0), kind="reserved")
+        g.set(cm.get("total_max_bytes", 0), kind="max")
+        reg.gauge("trino_cluster_blocked_nodes",
+                  "Workers reporting blocked memory pools").set(
+            cm.get("blocked_nodes", 0))
+        reg.counter("trino_memory_kills_total",
+                    "Queries killed by the low-memory killer / cluster "
+                    "cap").inc(cm.get("kills", 0))
+        states: Dict[str, int] = {}
+        for e in self.event_manager.history(10_000):
+            states[e.state] = states.get(e.state, 0) + 1
+        qc = reg.counter("trino_queries_total",
+                         "Completed queries by terminal state")
+        for state_name in ("FINISHED", "FAILED"):
+            qc.inc(states.get(state_name, 0), state=state_name)
+        reg.gauge("trino_queries_running",
+                  "Queries currently executing").set(
+            len(self.event_manager.running()))
+        reg.gauge("trino_workers_alive",
+                  "Live worker processes").set(
+            sum(1 for w in self.workers if w.alive))
+        return self.cluster_metrics.collect(process_families()
+                                            + reg.collect())
+
+    def runtime_tasks(self) -> list:
+        """Rows for ``system.runtime.tasks``: every task currently
+        tracked by a live worker (running AND finished-but-unreleased),
+        one poll per worker."""
+        rows = []
+        for i, w in enumerate(self.workers):
+            if not w.alive:
+                continue
+            try:
+                resp = w.rpc({"op": "task_status", "task_ids": None},
+                             timeout=10)
+            except OSError:
+                continue
+            for tid, st in sorted(resp.get("statuses", {}).items()):
+                rows.append((tid, tid.split(".", 1)[0], f"worker-{i}",
+                             (st.get("status") or "?").upper(),
+                             st.get("rows"), st.get("error_type")))
+        return rows
 
 
 class _WorkerLost(Exception):

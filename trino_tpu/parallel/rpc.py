@@ -48,6 +48,17 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
+def with_trace(request: dict, span, **extra) -> dict:
+    """Attach a span's propagation context to a task-RPC request
+    envelope (W3C-traceparent-style dict; see telemetry.tracing).  The
+    null span contributes nothing, so an untraced request carries zero
+    extra bytes — the zero-cost-when-off contract."""
+    ctx = span.context(**extra) if span is not None else None
+    if ctx:
+        request["trace"] = ctx
+    return request
+
+
 def call(addr, request: dict, timeout: float = 600.0) -> dict:
     """One request/response round trip on a fresh connection."""
     with socket.create_connection(addr, timeout=timeout) as sock:

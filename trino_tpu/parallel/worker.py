@@ -72,6 +72,9 @@ class _TaskState:
         self.serializers: Dict[tuple, object] = {}
         self.channels: List = []    # RemoteExchangeChannels to close
         self.thread = None
+        #: finished trace spans of this task (streaming tasks outlive
+        #: the run_task RPC, so spans are collected via task_status)
+        self.spans: List[dict] = []
         #: armed drop-connection occurrences: result pulls for this task
         #: close mid-frame this many times (FaultSchedule directive)
         self.drop_results = 0
@@ -87,6 +90,13 @@ class WorkerServer:
         #: per-query children are refcounted by their running tasks
         self.node_pool = None
         self._pool_refs: Dict[str, int] = {}
+        #: lifetime task counters for the metrics surface (heartbeat-
+        #: piggybacked; reference: SqlTaskManager's task stats).
+        #: Updated via _count_task under the lock: concurrent streaming
+        #: task threads would lose unsynchronized increments
+        self.tasks_finished = 0
+        self.tasks_failed = 0
+        self.task_rows = 0
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -139,7 +149,8 @@ class WorkerServer:
             self.stream_results(sock, req)
         elif op == "task_status":
             send_msg(sock, {"statuses": self.task_statuses(
-                req.get("task_ids"))})
+                req.get("task_ids"),
+                include_spans=bool(req.get("include_spans")))})
         elif op == "abort_task":
             self._abort_task(req["task_id"])
             send_msg(sock, {"ok": True})
@@ -159,14 +170,19 @@ class WorkerServer:
                 self.tasks.pop(req["task_id"], None)
             send_msg(sock, {"ok": True})
         elif op == "ping":
-            # the heartbeat PIGGYBACKS the node pool snapshot: the
-            # coordinator's ClusterMemoryManager sees every worker's
-            # per-query reservations without an extra RPC (reference:
-            # MemoryInfo riding the ServerInfo heartbeat)
+            # the heartbeat PIGGYBACKS the node pool snapshot AND the
+            # metrics-registry snapshot: the coordinator's
+            # ClusterMemoryManager/ClusterMetrics see every worker's
+            # state without an extra RPC (reference: MemoryInfo riding
+            # the ServerInfo heartbeat).  ONE snapshot() call — its
+            # blocked_events delta is consumed on read, so the metrics
+            # families must reuse it, never re-sample
+            memory = self.node_pool.snapshot() \
+                if self.node_pool is not None else None
             send_msg(sock, {"ok": True, "pid": os.getpid(),
                             "tasks": len(self.tasks),
-                            "memory": self.node_pool.snapshot()
-                            if self.node_pool is not None else None})
+                            "memory": memory,
+                            "metrics": self.metrics_families(memory)})
         elif op == "shutdown":
             send_msg(sock, {"ok": True})
             threading.Thread(target=self.server.shutdown,
@@ -184,7 +200,8 @@ class WorkerServer:
             for ch in state.channels:
                 ch.close()
 
-    def task_statuses(self, task_ids) -> dict:
+    def task_statuses(self, task_ids, include_spans: bool = False
+                      ) -> dict:
         out = {}
         with self._lock:
             items = [(tid, self.tasks.get(tid)) for tid in task_ids] \
@@ -201,7 +218,52 @@ class WorkerServer:
                                    if state.buffer is not None and
                                    hasattr(state.buffer, "overlapped")
                                    else False)}
+                if include_spans:
+                    # streaming tasks outlive their run_task ack: the
+                    # coordinator collects their finished spans here
+                    # (the heartbeat-piggyback pattern)
+                    out[tid]["spans"] = list(state.spans)
         return out
+
+    def metrics_families(self, memory: Optional[dict]) -> list:
+        """This process's metric families for the heartbeat piggyback:
+        the shared process-level sources (jit traces, exchange splits,
+        node pool) plus worker task counters."""
+        from ..telemetry.metrics import MetricsRegistry, process_families
+
+        fams = process_families(tasks=len(self.tasks), memory=memory)
+        reg = MetricsRegistry()
+        with self._lock:
+            finished, failed = self.tasks_finished, self.tasks_failed
+            rows = self.task_rows
+        c = reg.counter("trino_tasks_total",
+                        "Tasks run by this worker, by terminal status")
+        c.inc(finished, status="finished")
+        c.inc(failed, status="failed")
+        reg.counter("trino_task_rows_total",
+                    "Rows produced by finished tasks on this worker"
+                    ).inc(rows)
+        return fams + reg.collect()
+
+    def _count_task(self, ok: bool, rows: int = 0):
+        with self._lock:
+            if ok:
+                self.tasks_finished += 1
+                self.task_rows += rows
+            else:
+                self.tasks_failed += 1
+
+    @staticmethod
+    def _tracer_for(trace: Optional[dict]):
+        """A per-task tracer continuing the coordinator's trace, or the
+        shared no-op tracer when the request carries no context (tracing
+        off => zero work, nothing shipped back)."""
+        from ..telemetry.tracing import NULL_TRACER, Tracer
+
+        if not trace:
+            return NULL_TRACER
+        return Tracer(process=f"worker-{os.getpid()}",
+                      trace_id=trace.get("trace_id"))
 
     def sync_table(self, req: dict) -> dict:
         """Bring the local replica of a memory-catalog table up to the
@@ -284,24 +346,37 @@ class WorkerServer:
         if not req.get("streaming"):
             pool = self._acquire_query_pool(task_id,
                                             req.get("session", {}))
+            tracer, task_span = self._open_task_span(req, task_id)
             try:
                 self._apply_start_fault(fault, task_id)
                 state.rows = self._execute_fragment(req, state,
                                                     fault=fault,
-                                                    memory_pool=pool)
+                                                    memory_pool=pool,
+                                                    tracer=tracer,
+                                                    task_span=task_span)
                 state.status = "finished"
-                # the attempt's observed peak rides the response, so the
-                # coordinator's MemoryEstimator can size a retry even
-                # when no heartbeat sampled this short-lived pool
+                self._count_task(True, state.rows)
+                task_span.set("rows", state.rows)
+                task_span.finish()
+                # the attempt's observed peak AND the finished spans
+                # ride the response (piggyback: no extra RPC), so the
+                # coordinator's MemoryEstimator can size a retry and its
+                # tracer can assemble the full tree
                 return {"ok": True, "rows": state.rows,
-                        "memory_peak": pool.peak_bytes if pool else 0}
+                        "memory_peak": pool.peak_bytes if pool else 0,
+                        "spans": tracer.finished() or None}
             except Exception as e:
                 state.status = "failed"
+                self._count_task(False)
                 state.failure = serialize_failure(e)
                 state.error = state.failure["error"]
+                task_span.set("error", state.failure["error"])
+                task_span.set("error_type", state.failure["error_type"])
+                task_span.finish()
                 traceback.print_exc()
                 return dict(state.failure, task_id=task_id,
-                            memory_peak=pool.peak_bytes if pool else 0)
+                            memory_peak=pool.peak_bytes if pool else 0,
+                            spans=tracer.finished() or None)
             finally:
                 self._release_query_pool(task_id)
         # streaming: the buffer must exist before we acknowledge, so
@@ -317,6 +392,24 @@ class WorkerServer:
             daemon=True)
         state.thread.start()
         return {"ok": True, "started": True}
+
+    def _open_task_span(self, req: dict, task_id: str):
+        """(tracer, task span) for one task attempt: parented to the
+        coordinator's attempt span via the RPC trace envelope, tagged
+        with attempt number / speculative flag so retries read as
+        sibling attempts in the tree."""
+        trace = req.get("trace")
+        tracer = self._tracer_for(trace)
+        attrs = {"task_id": task_id, "span_kind": "task",
+                 "fragment": getattr(req.get("fragment"), "fragment_id",
+                                     None),
+                 "pid": os.getpid()}
+        if trace:
+            for key in ("attempt", "speculative"):
+                if key in trace:
+                    attrs[key] = trace[key]
+        return tracer, tracer.span(f"task {task_id}", parent=trace,
+                                   **attrs)
 
     @staticmethod
     def _task_fault(req: dict) -> dict:
@@ -357,13 +450,23 @@ class WorkerServer:
 
         pool = self._acquire_query_pool(req["task_id"],
                                         req.get("session", {}))
+        tracer, task_span = self._open_task_span(req, req["task_id"])
         try:
             self._apply_start_fault(fault, req["task_id"])
             state.rows = self._execute_fragment(req, state,
                                                 streaming=True,
                                                 fault=fault,
-                                                memory_pool=pool)
+                                                memory_pool=pool,
+                                                tracer=tracer,
+                                                task_span=task_span)
             state.status = "finished"
+            self._count_task(True, state.rows)
+            task_span.set("rows", state.rows)
+            task_span.finish()
+            # park spans BEFORE signalling EOS: a consumer that saw the
+            # end of this buffer must find the spans already collectable
+            # via task_status (no race with the span-collection poll)
+            state.spans = tracer.finished()
             state.buffer.set_no_more_pages()
         except ExchangeConnectionLost as e:
             state.error = f"[connection-lost] {e!r}"
@@ -371,15 +474,26 @@ class WorkerServer:
             state.failure["error"] = state.error
             state.failure["connection_lost"] = True
             state.status = "failed"
+            self._count_task(False)
             state.buffer.abort()
         except Exception as e:
             state.failure = serialize_failure(e)
             state.error = state.failure["error"]
             state.status = "failed"
+            self._count_task(False)
             if not state.abort.is_set():
                 traceback.print_exc()
             state.buffer.abort()
         finally:
+            if state.failure is not None:
+                task_span.set("error", state.failure["error"])
+                task_span.set("error_type",
+                              state.failure["error_type"])
+            task_span.finish()
+            # a streaming task outlives its run_task ack: finished
+            # spans park on the state for task_status collection
+            if not state.spans:
+                state.spans = tracer.finished()
             self._release_query_pool(req["task_id"])
             for ch in state.channels:
                 ch.close()
@@ -423,7 +537,8 @@ class WorkerServer:
     def _execute_fragment(self, req: dict, state: _TaskState,
                           streaming: bool = False,
                           fault: Optional[dict] = None,
-                          memory_pool=None) -> int:
+                          memory_pool=None, tracer=None,
+                          task_span=None) -> int:
         from ..exec.driver import Driver
         from ..exec.local_planner import (LocalExecutionPlanner,
                                           grouping_options,
@@ -432,10 +547,13 @@ class WorkerServer:
         from ..exec.serde import PageDeserializer
         from ..ops.output import OutputBuffer, PartitionedOutputOperator
         from ..planner.logical_planner import Metadata
+        from ..telemetry.tracing import NULL_TRACER, add_driver_spans
         from .remote_exchange import (RemoteExchangeChannel,
                                       run_driver_blocking)
         from .rpc import fetch_pages
 
+        if tracer is None:
+            tracer = NULL_TRACER
         frag = req["fragment"]
         upstream: Dict[int, dict] = req["upstream"]
         task_index = req["task_index"]
@@ -509,9 +627,11 @@ class WorkerServer:
             scan_coalesce=session_props.get("scan_coalesce_enabled", True),
             **grouping_options(session_props))
 
-        ops, layout, types_ = planner.visit(frag.root)
-        ops, layout, types_, key_channels = project_to_wire_layout(
-            frag, ops, layout, types_)
+        with tracer.span("plan", parent=task_span,
+                         task_id=req["task_id"]):
+            ops, layout, types_ = planner.visit(frag.root)
+            ops, layout, types_, key_channels = project_to_wire_layout(
+                frag, ops, layout, types_)
         if streaming:
             buffer = state.buffer  # pre-created by run_task
         else:
@@ -535,11 +655,23 @@ class WorkerServer:
                                              frag.output_kind,
                                              rebalancer=rebalancer))
         planner.pipelines.append(PhysicalPipeline(ops))
-        for p in planner.pipelines:
-            if streaming:
-                run_driver_blocking(Driver(p.operators), state.abort)
-            else:
-                Driver(p.operators).run_to_completion()
+        # the exec span is the driver-run wall: its operator children's
+        # busy time must account for ~all of it (the trace-tree test's
+        # attribution invariant); stats collection costs two clock
+        # reads per page move and only runs when tracing is on
+        with tracer.span("exec", parent=task_span,
+                         task_id=req["task_id"],
+                         span_kind="exec") as exec_span:
+            drivers = []
+            for p in planner.pipelines:
+                d = Driver(p.operators, collect_stats=tracer.enabled)
+                drivers.append(d)
+                if streaming:
+                    run_driver_blocking(d, state.abort)
+                else:
+                    d.run_to_completion()
+        for d in drivers:
+            add_driver_spans(tracer, d, exec_span)
         spool_dir = req.get("spool_dir")
         if spool_dir:
             # durable publish BEFORE reporting success: a retried

@@ -159,6 +159,22 @@ class ResourceGroupManager:
         return cls([spec(d) for d in doc.get("groups",
                                              [{"name": "global"}])])
 
+    def stats(self) -> List[tuple]:
+        """Queue-depth snapshot over the whole tree — one
+        ``(name, running, queued, memory_reserved)`` row per group,
+        depth-first — the metrics-registry / system.runtime source
+        (reference: resource-group JMX stats)."""
+        out: List[tuple] = []
+
+        def walk(groups: List[ResourceGroup]):
+            for g in groups:
+                out.append((g.name, g.running, g.queued,
+                            g.memory_reserved))
+                walk(g.subgroups)
+
+        walk(self.roots)
+        return out
+
     def select(self, user: str) -> ResourceGroup:
         def match(groups: List[ResourceGroup]) -> Optional[ResourceGroup]:
             for g in groups:

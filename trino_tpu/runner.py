@@ -47,6 +47,14 @@ class LocalQueryRunner:
         from .events import EventListenerManager
         from .security import ALLOW_ALL
 
+        connectors = dict(connectors)
+        if "system" not in connectors:
+            # the system catalog serves THIS runner's live state
+            # (system.runtime.queries/tasks/metrics) — wired here so
+            # every runner has it without config
+            from .connectors.system import SystemConnector
+
+            connectors["system"] = SystemConnector(source=self)
         self.metadata = Metadata(connectors)
         self.session = session or Session(
             catalog=next(iter(connectors), None))
@@ -100,12 +108,15 @@ class LocalQueryRunner:
         """Admission (resource group) + access control + event firing
         around one statement (reference: DispatchManager.createQuery's
         admission path + QueryMonitor)."""
+        import time as _time
+
         from .events import QueryMonitor
 
         self.access_control.check_can_execute_query(self.session.user)
         monitor = QueryMonitor(self.event_manager, self.session.user,
                                sql) if self.event_manager.listeners \
             else None
+        t0 = _time.perf_counter()
         if monitor:
             monitor.created()
         try:
@@ -125,7 +136,14 @@ class LocalQueryRunner:
                 monitor.failed(e)
             raise
         if monitor:
-            monitor.completed(len(res.rows))
+            # the QueryStatistics analog: peak memory + wall ride the
+            # completed event into the history ring buffer that backs
+            # system.runtime.queries
+            monitor.completed(len(res.rows), stats={
+                "wall_ms": round((_time.perf_counter() - t0) * 1e3, 2),
+                "peak_memory_bytes": ((res.stats or {}).get("memory")
+                                      or {}).get("peak_bytes", 0),
+            })
         return res
 
     def _execute_sql(self, sql: str) -> QueryResult:
@@ -283,6 +301,37 @@ class LocalQueryRunner:
                 lines.append("  " + st.line())
         return QueryResult(["Query Plan"], [T.VARCHAR],
                            [(line,) for line in lines])
+
+    def metrics_families(self) -> list:
+        """This runner's metric families for GET /v1/metrics and
+        system.runtime.metrics: process-level sources (jit traces,
+        exchange counters) + query lifecycle counters + resource-group
+        queue depths when admission control is configured."""
+        from .telemetry.metrics import MetricsRegistry, process_families
+
+        reg = MetricsRegistry()
+        states = {"FINISHED": 0, "FAILED": 0}
+        for e in self.event_manager.history(10_000):
+            states[e.state] = states.get(e.state, 0) + 1
+        qc = reg.counter("trino_queries_total",
+                         "Completed queries by terminal state")
+        for state_name, n in sorted(states.items()):
+            qc.inc(n, state=state_name)
+        reg.gauge("trino_queries_running",
+                  "Queries currently executing").set(
+            len(self.event_manager.running()))
+        if self.resource_groups is not None:
+            g = reg.gauge("trino_resource_group_queries",
+                          "Resource-group admission state "
+                          "(kind=running|queued)")
+            m = reg.gauge("trino_resource_group_memory_reserved_bytes",
+                          "Memory budget admitted per resource group")
+            for name, running, queued, mem in \
+                    self.resource_groups.stats():
+                g.set(running, group=name, kind="running")
+                g.set(queued, group=name, kind="queued")
+                m.set(mem, group=name)
+        return process_families() + reg.collect()
 
     def _connector(self, catalog: Optional[str]) -> Connector:
         conn = self.metadata.connectors.get(catalog or "")

@@ -43,10 +43,11 @@ def _json_value(v, type_: T.Type):
 
 
 class _QueryState:
-    def __init__(self, qid: str):
+    def __init__(self, qid: str, sql: str = ""):
         import time
 
         self.id = qid
+        self.sql = sql
         self.state = "QUEUED"
         self.error: Optional[dict] = None
         self.result = None
@@ -55,14 +56,39 @@ class _QueryState:
 
 
 class ProtocolServer:
-    """The coordinator's client-facing HTTP surface."""
+    """The coordinator's client-facing HTTP surface.
+
+    Endpoints beyond the statement protocol (reference:
+    ``server/QueryResource.java`` + the metrics exposition):
+    - ``GET /v1/query/{id}``: the query's stats tree
+      (``QueryStatsTree.to_dict()`` — memory, recovery, cluster memory,
+      trace spans) for running and finished queries; finished ones are
+      retained in a bounded history, 404 once evicted;
+    - ``GET /v1/metrics``: Prometheus text exposition of the runner's
+      metric families (cluster-aggregated for the process runner) plus
+      this server's own query counters.
+    """
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
-                 page_size: int = 1000, query_ttl: float = 3600.0):
+                 page_size: int = 1000, query_ttl: float = 3600.0,
+                 history_size: int = 100):
+        from ..telemetry.metrics import MetricsRegistry
+
         self.runner = runner
         self.page_size = page_size
         self.query_ttl = query_ttl
         self.queries: Dict[str, _QueryState] = {}
+        #: finished-query info retained for GET /v1/query/{id}
+        #: (bounded ring: oldest evicted first -> 404); the lock keeps
+        #: concurrent executor threads from double-popping the same
+        #: oldest key at capacity
+        self.finished: "Dict[str, dict]" = {}
+        self._finished_lock = threading.Lock()
+        self.history_size = history_size
+        self.registry = MetricsRegistry()
+        self._http_queries = self.registry.counter(
+            "trino_http_statements_total",
+            "Statements submitted over /v1/statement, by outcome")
         self.executor = ThreadPoolExecutor(max_workers=4)
         outer = self
 
@@ -76,6 +102,15 @@ class ProtocolServer:
                 body = json.dumps(doc).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_text(self, code: int, text: str):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -102,6 +137,15 @@ class ProtocolServer:
                 elif self.path == "/v1/status":
                     self._reply(200, {"nodeId": "coordinator",
                                       "state": "ACTIVE"})
+                elif self.path == "/v1/metrics":
+                    self._reply_text(200, outer.metrics_text())
+                elif len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                    info = outer.query_info(parts[2])
+                    if info is None:
+                        self._reply(404, {"error":
+                                          f"unknown query {parts[2]}"})
+                    else:
+                        self._reply(200, info)
                 else:
                     self._reply(404, {"error": "not found"})
 
@@ -152,14 +196,18 @@ class ProtocolServer:
     def submit(self, sql: str) -> dict:
         self._evict_abandoned()
         qid = uuid.uuid4().hex[:16]
-        q = _QueryState(qid)
+        q = _QueryState(qid, sql)
         self.queries[qid] = q
 
         def run():
+            import time
+
             q.state = "RUNNING"
+            t0 = time.perf_counter()
             try:
                 q.result = self.runner.execute(sql)
                 q.state = "FINISHED"
+                self._http_queries.inc(state="FINISHED")
             except Exception as e:
                 q.error = {
                     "message": str(e),
@@ -167,6 +215,8 @@ class ProtocolServer:
                     "errorType": type(e).__name__,
                 }
                 q.state = "FAILED"
+                self._http_queries.inc(state="FAILED")
+            self._record_finished(q, (time.perf_counter() - t0) * 1e3)
 
         self.executor.submit(run)
         return {
@@ -174,6 +224,63 @@ class ProtocolServer:
             "nextUri": f"{self.uri}/v1/statement/executing/{qid}/0",
             "stats": {"state": q.state},
         }
+
+    def _record_finished(self, q: _QueryState, wall_ms: float):
+        """Retain the finished query's stats tree for GET /v1/query/{id}
+        (reference: QueryResource over the QueryTracker history). The
+        ring is bounded: the oldest entry evicts, after which the id
+        404s."""
+        from ..exec.stats import QueryStatsTree
+
+        stats = (q.result.stats if q.result is not None
+                 and q.result.stats else {}) or {}
+        tree = QueryStatsTree(
+            wall_ms=wall_ms,
+            memory=stats.get("memory"),
+            cluster_memory=stats.get("cluster_memory"),
+            recovery=stats.get("recovery"),
+            trace=stats.get("trace"))
+        info = {
+            "queryId": q.id, "state": q.state, "query": q.sql,
+            "rows": len(q.result.rows) if q.result is not None else 0,
+            "error": q.error,
+            "stats": tree.to_dict(),
+        }
+        with self._finished_lock:
+            while len(self.finished) >= self.history_size:
+                self.finished.pop(next(iter(self.finished)))
+            self.finished[q.id] = info
+
+    def query_info(self, qid: str) -> Optional[dict]:
+        """GET /v1/query/{id}: full stats-tree JSON for a finished (or
+        failed) query, live state for one still executing, None (404)
+        for unknown/evicted ids."""
+        with self._finished_lock:
+            done = self.finished.get(qid)
+        if done is not None:
+            return done
+        q = self.queries.get(qid)
+        if q is None:
+            return None
+        return {"queryId": qid, "state": q.state, "query": q.sql,
+                "error": q.error, "stats": None}
+
+    def evict_query(self, qid: str):
+        """Drop a finished query from the /v1/query history (tests +
+        admin surface); subsequent lookups 404."""
+        with self._finished_lock:
+            self.finished.pop(qid, None)
+
+    def metrics_text(self) -> str:
+        """GET /v1/metrics: Prometheus text exposition of the runner's
+        families + this server's statement counters."""
+        from ..telemetry.metrics import (merge_families,
+                                         render_prometheus)
+
+        fams = getattr(self.runner, "metrics_families", None)
+        runner_fams = fams() if callable(fams) else []
+        return render_prometheus(
+            merge_families(runner_fams, self.registry.collect()))
 
     def poll(self, qid: str, token: int) -> dict:
         q = self.queries.get(qid)

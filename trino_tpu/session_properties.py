@@ -246,6 +246,15 @@ register(SessionProperty(
     "collectives/pages, so assignments cannot flap on bursty input",
     lambda v: v >= 1))
 register(SessionProperty(
+    "query_tracing_enabled", "boolean", True,
+    "Distributed tracing: the coordinator opens a root span per query "
+    "with plan/fragment/attempt children, span context rides every "
+    "task RPC, and workers return task/operator spans that assemble "
+    "into one tree (QueryResult.stats['trace'], Chrome-trace export, "
+    "EXPLAIN ANALYZE Trace: line). Consulted by the multi-process "
+    "runner; zero-cost when off (no-op spans, nothing shipped), and "
+    "spans are never opened inside jit'd code"))
+register(SessionProperty(
     "device_exchange_sizing", "varchar", "history",
     "How the device collective picks its all_to_all lane capacity "
     "(per_dest): EXACT = count-first pass (tiny counting collective, "
