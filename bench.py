@@ -49,7 +49,10 @@ counters and rows/s, rc=6 on mismatch); BENCH_ROLE=trace / BENCH_TRACE=1
 query tracing, writes the Perfetto-loadable Chrome-trace artifact to
 BENCH_TRACE_PATH [default ./BENCH_TRACE.json], emits a
 trace_stage_overlap metric line + TRACE_RESULT, rc=7 on a
-disconnected/empty trace tree). Every rate line carries
+disconnected/empty trace tree). The parent runs the qlint static
+analyzer as a pre-flight before spawning any child (rc=8 on
+non-baselined findings: retrace-hazardous code must not burn the TPU
+budget; BENCH_SKIP_QLINT=1 skips). Every rate line carries
 backend/device_kind provenance so a CPU fallback can never masquerade
 as a TPU number.
 """
@@ -539,8 +542,58 @@ def _emit(state, res, suffix, base, cached_base=False):
         }))
 
 
+def _load_qlint():
+    """Load trino_tpu/analysis as a SYNTHETIC package by file path —
+    NOT through ``import trino_tpu`` — because the parent package's
+    __init__ imports jax, and this parent process must never import
+    jax (a down axon tunnel hangs the import forever, before the
+    watchdog thread even exists — the round-5 failure the parent/
+    child split was built to prevent). The analysis package is
+    self-contained stdlib-ast, so its relative imports resolve inside
+    the synthetic package without touching trino_tpu/__init__.py."""
+    import importlib.util
+
+    pkg_dir = os.path.join(REPO, "trino_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "_bench_qlint", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_bench_qlint"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _qlint_preflight():
+    """Run the static analyzer BEFORE spawning any bench child: code
+    that would retrace per page (or deadlock a worker) burns the whole
+    380 s TPU budget producing a garbage number — fail fast with a
+    DISTINCT rc=8 instead. Pure stdlib ast, no JAX import, ~3 s.
+    BENCH_SKIP_QLINT=1 skips (emergency escape hatch only)."""
+    if os.environ.get("BENCH_SKIP_QLINT") == "1":
+        return
+    qlint = _load_qlint()
+    assert "jax" not in sys.modules, \
+        "qlint pre-flight must not import jax in the bench parent"
+
+    package = os.path.join(REPO, "trino_tpu")
+    findings = qlint.run_passes(qlint.ProjectIndex.from_package(package))
+    baseline = qlint.load_baseline(qlint.default_baseline_path(package))
+    new, _suppressed, stale = qlint.apply_baseline(findings, baseline)
+    if new or stale:
+        for f in new:
+            sys.stderr.write(f"qlint: {f.render()}\n")
+        for key in stale:
+            sys.stderr.write(f"qlint: STALE baseline entry {key}\n")
+        sys.stderr.write(
+            f"bench: qlint pre-flight failed "
+            f"({len(new)} finding(s), {len(stale)} stale) — not "
+            f"spending the TPU budget on hazardous code\n")
+        sys.exit(8)
+
+
 def main():
     schema = os.environ.get("BENCH_SCHEMA", "tiny")
+    _qlint_preflight()
     deadline = float(os.environ.get("BENCH_DEADLINE", "520"))
     tpu_budget = float(os.environ.get("BENCH_TPU_BUDGET", "380"))
     t_start = time.time()

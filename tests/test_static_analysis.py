@@ -1,0 +1,589 @@
+"""qlint analyzer tests: per-pass fixture snippets (one known-bad and
+one known-good each) so the passes cannot silently go blind, plus the
+tier-1 gate that runs every pass over ``trino_tpu/`` and fails on any
+non-baselined finding.
+
+The analysis package itself is pure stdlib ``ast`` (bench.py loads it
+by file path to keep the bench parent jax-free); these tests must
+stay fast (<30 s).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from trino_tpu.analysis import (PASSES, ProjectIndex, apply_baseline,
+                                default_baseline_path, load_baseline,
+                                run_passes)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "trino_tpu")
+
+
+def index_of(**sources):
+    """Fixture index from {module_name: dedented source}."""
+    return ProjectIndex.from_sources(
+        {name: textwrap.dedent(src) for name, src in sources.items()})
+
+
+def rules(findings):
+    return {(f.pass_id, f.rule) for f in findings}
+
+
+# -- trace-purity --------------------------------------------------------
+
+def test_trace_purity_catches_span_inside_jit():
+    idx = index_of(**{"pkg.kern": """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("n",))
+        def kernel(x, n):
+            with tracer.span("kernel"):
+                return helper(x)
+
+        def helper(x):
+            print("tracing", x)
+            return x
+    """})
+    found = run_passes(idx, ["trace-purity"])
+    assert ("trace-purity", "telemetry-in-trace") in rules(found)
+    # interprocedural: helper's print() reached through the call graph
+    assert ("trace-purity", "host-io") in rules(found)
+    assert any(f.qualname == "helper" for f in found)
+
+
+def test_trace_purity_call_form_entry_and_lock():
+    idx = index_of(**{"pkg.build": """
+        import jax, time, threading
+
+        _lock = threading.Lock()
+
+        def build():
+            def staged(x):
+                with _lock:
+                    t = time.time()
+                return x
+            return jax.jit(staged)
+    """})
+    found = run_passes(idx, ["trace-purity"])
+    got = rules(found)
+    assert ("trace-purity", "lock-in-trace") in got
+    assert ("trace-purity", "host-time") in got
+
+
+def test_trace_purity_clean_kernel_and_allowlisted_counter():
+    idx = index_of(**{"pkg.ok": """
+        import jax
+        import jax.numpy as jnp
+        from .. import jit_stats
+
+        @jax.jit
+        def kernel(x):
+            jit_stats.bump("kernel")   # designed trace-time counter
+            return jnp.sum(x * 2)
+
+        def host_side():
+            print("fine out here")
+            import time
+            time.sleep(0)
+    """})
+    assert run_passes(idx, ["trace-purity"]) == []
+
+
+def test_trace_purity_sees_through_package_init_reexports():
+    """A helper re-exported through a package __init__ must stay on
+    the call graph: package-__init__ relative imports resolve against
+    the package itself, not its parent."""
+    idx = ProjectIndex.from_sources({
+        "pkg.tel": textwrap.dedent("""
+            import jax
+
+            from .inner import span_helper
+
+            @jax.jit
+            def kernel(x):
+                return span_helper(x)
+        """),
+        "pkg.tel.inner": textwrap.dedent("""
+            def span_helper(x):
+                print("host effect")
+                return x
+        """),
+    }, packages=("pkg.tel",))
+    found = run_passes(idx, ["trace-purity"])
+    assert ("trace-purity", "host-io") in rules(found)
+
+
+def test_bare_call_in_method_binds_module_level_not_sibling_method():
+    """Python scoping: `helper()` inside C.m is the module-level
+    helper, never the sibling method — a misresolution here fabricates
+    false lock cycles / masks real host effects."""
+    idx = index_of(**{"pkg.m": """
+        import jax
+
+        def helper(x):
+            print("reached")
+            return x
+
+        class C:
+            @jax.jit
+            def m(self, x):
+                return helper(x)
+
+            def helper(self, x):
+                return x
+    """})
+    found = run_passes(idx, ["trace-purity"])
+    assert [f.qualname for f in found] == ["helper"]
+    assert found[0].rule == "host-io"
+
+
+def test_trace_purity_pragma_opt_out():
+    idx = index_of(**{"pkg.cfg": """
+        import jax, os
+
+        @jax.jit
+        def kernel(x):
+            mode = os.environ.get("MODE", "")  # qlint: ignore[trace-purity]
+            return x
+    """})
+    assert run_passes(idx, ["trace-purity"]) == []
+
+
+# -- lock-order ----------------------------------------------------------
+
+AB_BA = """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def demote(self, pool: "Pool"):
+            with self._lock:
+                pool.reserve()
+
+        def park(self):
+            with self._lock:
+                pass
+
+    class Pool:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def reserve(self):
+            with self._lock:
+                pass
+
+        def revoke(self, ledger: Ledger):
+            with self._lock:
+                ledger.park()
+"""
+
+
+def test_lock_order_catches_seeded_ab_ba_cycle():
+    idx = index_of(**{"pkg.spill": AB_BA})
+    found = run_passes(idx, ["lock-order"])
+    cycles = [f for f in found if f.rule == "lock-cycle"]
+    assert len(cycles) == 1
+    assert "Ledger._lock" in cycles[0].message
+    assert "Pool._lock" in cycles[0].message
+
+
+def test_lock_order_consistent_order_is_clean():
+    # same two locks, always acquired Ledger -> Pool: no cycle
+    idx = index_of(**{"pkg.spill": """
+        import threading
+
+        class Ledger:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def demote(self, pool: "Pool"):
+                with self._lock:
+                    pool.reserve()
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def reserve(self):
+                with self._lock:
+                    pass
+    """})
+    assert run_passes(idx, ["lock-order"]) == []
+
+
+def test_lock_order_nonblocking_acquire_breaks_no_cycle():
+    # PR 5's demote_across pattern: the back-edge uses
+    # acquire(blocking=False), which cannot deadlock
+    idx = index_of(**{"pkg.spill": AB_BA.replace(
+        "ledger.park()",
+        "ledger._lock.acquire(blocking=False)")})
+    found = run_passes(idx, ["lock-order"])
+    assert [f for f in found if f.rule == "lock-cycle"] == []
+
+
+def test_lock_order_self_deadlock_and_rlock_exemption():
+    idx = index_of(**{"pkg.locks": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+
+        class B:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def outer(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """})
+    found = run_passes(idx, ["lock-order"])
+    subs = {f.subject for f in found if f.rule == "self-deadlock"}
+    assert "self:pkg.locks.A._lock" in subs          # Lock: deadlock
+    assert not any("B._lock" in s for s in subs)     # RLock: reentrant
+
+
+def test_lock_order_rpc_under_lock():
+    idx = index_of(**{"pkg.srv": """
+        import threading, subprocess
+
+        _lock = threading.Lock()
+
+        def ship(frames):
+            with _lock:
+                subprocess.run(["scp", "x"])
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert ("lock-order", "lock-over-rpc") in rules(found)
+
+
+# -- recompile -----------------------------------------------------------
+
+def test_recompile_unhashable_arg_and_session_read():
+    idx = index_of(**{"pkg.exch": """
+        from functools import lru_cache
+        from .. import session_properties as SP
+
+        @lru_cache(maxsize=8)
+        def build_program(mesh, opts):
+            min_c = SP.prop_value({}, "rebalance_min_collectives")
+            return (mesh, opts, min_c)
+
+        def run(mesh):
+            return build_program(mesh, {"sizing": "exact"})
+    """})
+    found = run_passes(idx, ["recompile"])
+    got = rules(found)
+    assert ("recompile", "unhashable-arg") in got
+    assert ("recompile", "cached-builder-reads-session") in got
+    session = [f for f in found
+               if f.rule == "cached-builder-reads-session"]
+    assert "rebalance_min_collectives" in session[0].message
+
+
+def test_recompile_traced_branch():
+    idx = index_of(**{"pkg.kern": """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("exact",))
+        def kernel(x, exact):
+            if exact:            # static: fine
+                pass
+            if x > 0:            # traced: TracerBoolConversionError
+                return x
+            return -x
+    """})
+    found = run_passes(idx, ["recompile"])
+    branches = [f for f in found if f.rule == "traced-branch"]
+    assert len(branches) == 1
+    assert "`x`" in branches[0].message
+
+
+def test_recompile_static_accessors_are_clean():
+    idx = index_of(**{"pkg.kern": """
+        import jax
+
+        @jax.jit
+        def kernel(x, lut):
+            if x.shape[0] > 128:   # shapes are static under jit
+                pass
+            if len(x.shape) == 2:
+                pass
+            if lut is None:        # pytree structure is static
+                return x
+            return x
+
+        def run(mesh, key):
+            return build(mesh, tuple(sorted(key)))
+    """})
+    assert run_passes(idx, ["recompile"]) == []
+
+
+# -- session-props -------------------------------------------------------
+
+SP_REG = """
+    REGISTRY = {}
+
+    def register(prop):
+        REGISTRY[prop.name] = prop
+
+    class SessionProperty:
+        def __init__(self, name, type, default, description):
+            self.name = name
+
+    register(SessionProperty(
+        "knob_used", "integer", 4, "read below"))
+    register(SessionProperty(
+        "knob_dead", "boolean", False, "never read"))
+    register(SessionProperty(
+        "knob_typo", "int", 0, "bad type vocab"))
+
+    def value(session, name):
+        return REGISTRY[name]
+
+    def prop_value(props, name):
+        return props.get(name)
+"""
+
+
+def test_session_props_dead_undeclared_and_bad_type():
+    idx = index_of(**{
+        "pkg.session_properties": SP_REG,
+        "pkg.engine": """
+            from . import session_properties as SP
+
+            def plan(session):
+                a = SP.value(session, "knob_used")
+                b = SP.value(session, "knob_missing")
+                return a, b
+        """,
+    })
+    found = run_passes(idx, ["session-props"])
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f)
+    assert [f.subject for f in by_rule["dead-property"]] \
+        == ["dead:knob_dead", "dead:knob_typo"]
+    assert by_rule["undeclared-lookup"][0].subject \
+        == "undeclared:knob_missing"
+    assert by_rule["bad-type"][0].subject == "bad-type:knob_typo"
+
+
+def test_session_props_every_declared_and_read_is_clean():
+    idx = index_of(**{
+        "pkg.session_properties": SP_REG.replace(
+            '    register(SessionProperty(\n        "knob_dead"',
+            '    _ = (lambda: None) or register(SessionProperty(\n'
+            '        "knob_dead"').replace(
+            '"knob_typo", "int"', '"knob_typo", "integer"'),
+        "pkg.engine": """
+            from . import session_properties as SP
+
+            def plan(session):
+                return (SP.value(session, "knob_used"),
+                        SP.value(session, "knob_dead"),
+                        SP.prop_value({}, "knob_typo"))
+        """,
+    })
+    assert run_passes(idx, ["session-props"]) == []
+
+
+# -- taxonomy ------------------------------------------------------------
+
+def test_taxonomy_bare_raise_and_broad_swallow():
+    idx = index_of(**{"pkg.parallel.worker": """
+        def flush(resp):
+            if not resp.get("ok"):
+                raise RuntimeError("sink rejected")
+
+        def loop():
+            try:
+                flush({})
+            except Exception:
+                pass
+    """})
+    found = run_passes(idx, ["taxonomy"])
+    got = rules(found)
+    assert ("taxonomy", "bare-raise") in got
+    assert ("taxonomy", "broad-swallow") in got
+
+
+def test_taxonomy_typed_raise_and_routed_handler_are_clean():
+    idx = index_of(**{"pkg.parallel.worker": """
+        from .fault import RemoteTaskError, serialize_failure
+
+        def flush(resp):
+            if not resp.get("ok"):
+                raise RemoteTaskError("sink rejected", "INTERNAL")
+
+        def loop(sock):
+            try:
+                flush({})
+            except Exception as e:
+                sock.send(serialize_failure(e))
+
+        def reraise():
+            try:
+                flush({})
+            except Exception:
+                raise
+    """})
+    assert run_passes(idx, ["taxonomy"]) == []
+
+
+def test_taxonomy_scoped_to_parallel_and_pragma():
+    idx = index_of(**{
+        # outside parallel/: not this pass's business
+        "pkg.ops.sort": "def f():\n    raise RuntimeError('x')\n",
+        # fault.py defines the vocabulary: exempt
+        "pkg.parallel.fault": "def g():\n    raise RuntimeError('y')\n",
+        "pkg.parallel.chaos": """
+            def inject(task_id):
+                raise RuntimeError(  # qlint: ignore[taxonomy]
+                    f"injected failure for {task_id}")
+        """,
+    })
+    assert run_passes(idx, ["taxonomy"]) == []
+
+
+# -- framework plumbing --------------------------------------------------
+
+def test_unknown_pass_rejected():
+    idx = index_of(**{"pkg.m": "x = 1\n"})
+    with pytest.raises(ValueError, match="unknown passes"):
+        run_passes(idx, ["no-such-pass"])
+
+
+def test_finding_keys_are_line_stable():
+    src = """
+        def flush(resp):
+            raise RuntimeError("boom")
+    """
+    a = run_passes(index_of(**{"pkg.parallel.m": src}), ["taxonomy"])
+    b = run_passes(index_of(**{"pkg.parallel.m": "\n\n\n" + textwrap.dedent(src)}),
+                   ["taxonomy"])
+    assert [f.key for f in a] == [f.key for f in b]
+    assert a[0].line != b[0].line
+
+
+def test_apply_baseline_splits_new_suppressed_stale():
+    idx = index_of(**{"pkg.parallel.m": """
+        def f():
+            raise RuntimeError("a")
+
+        def g():
+            raise Exception("b")
+    """})
+    found = run_passes(idx, ["taxonomy"])
+    assert len(found) == 2
+    baseline = {found[0].key: "triaged", "gone:key": "stale"}
+    new, suppressed, stale = apply_baseline(found, baseline)
+    assert [f.key for f in new] == [found[1].key]
+    assert [f.key for f in suppressed] == [found[0].key]
+    assert stale == ["gone:key"]
+
+
+# -- the tier-1 gate -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def repo_findings():
+    index = ProjectIndex.from_package(PACKAGE)
+    return index, run_passes(index)
+
+
+def test_gate_repo_is_clean_modulo_baseline(repo_findings):
+    """THE gate: every pass over trino_tpu/, zero non-baselined
+    findings, no stale baseline entries (the baseline only shrinks)."""
+    _index, findings = repo_findings
+    baseline = load_baseline(default_baseline_path(PACKAGE))
+    new, _suppressed, stale = apply_baseline(findings, baseline)
+    assert not new, "non-baselined findings:\n" + "\n".join(
+        f.render() for f in new)
+    assert not stale, ("baseline entries that no longer fire "
+                       "(remove them): " + ", ".join(stale))
+    # the baseline may only shrink: at PR 7 every first-run finding
+    # was fixed instead of baselined, so any growth is a regression
+    assert len(baseline) <= 0, \
+        "analysis_baseline.json grew — fix new findings instead"
+
+
+def test_gate_passes_are_not_blind_on_the_real_repo(repo_findings):
+    """The gate is only meaningful if the passes actually index the
+    engine: staged-out entry points, locks, cached builders and the
+    property registry must all be visible."""
+    from trino_tpu.analysis.trace_purity import jit_entries
+    from trino_tpu.analysis.recompile import _cached_functions
+    from trino_tpu.analysis.session_props import (_declarations,
+                                                  _registry_module)
+    index, _ = repo_findings
+    entries = jit_entries(index)
+    assert len(entries) >= 15, sorted(entries)
+    assert any(e.kind == "shard_map" for e in entries.values())
+    assert "trino_tpu.parallel.device_exchange:_exchange_program.prog" \
+        in entries
+    cached = _cached_functions(index)
+    assert "trino_tpu.parallel.device_exchange:_exchange_program" \
+        in cached
+    declared = _declarations(_registry_module(index))
+    assert len(declared) >= 30
+    assert declared["retry_policy"][0] == "varchar"
+    assert "page_rows" not in declared
+
+
+def test_cli_runs_clean_and_json(tmp_path):
+    """`python -m trino_tpu.analysis` end to end: rc 0 on the clean
+    tree, JSON shape, and rc 1 + stale reporting on a bad baseline."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "trino_tpu.analysis", "--json", PACKAGE],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["new"] == []
+    assert payload["stale_baseline_keys"] == []
+    assert sorted(payload["passes"]) == sorted(PASSES)
+
+    bad = tmp_path / "baseline.json"
+    bad.write_text(json.dumps(
+        {"findings": [{"key": "taxonomy:bare-raise:gone:f:raise",
+                       "note": "stale"}]}))
+    out = subprocess.run(
+        [sys.executable, "-m", "trino_tpu.analysis", PACKAGE,
+         "--baseline", str(bad)],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert out.returncode == 1
+    assert "STALE" in out.stdout
+
+
+def test_cli_pass_selection(tmp_path):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "trino_tpu.analysis",
+         "--passes", "session-props,taxonomy", "--json", PACKAGE],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert json.loads(out.stdout)["passes"] == ["session-props",
+                                               "taxonomy"]
+    out = subprocess.run(
+        [sys.executable, "-m", "trino_tpu.analysis",
+         "--passes", "bogus", PACKAGE],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+    assert out.returncode == 2

@@ -1,0 +1,139 @@
+"""qlint — repo-native static analysis for the engine's load-bearing
+invariants.
+
+The engine carries several correctness invariants that exist only as
+prose in docstrings and PR descriptions; each was a hand-found bug
+once.  This package machine-checks them with stdlib ``ast`` (no JAX
+import, no new deps) over a shared module-index/call-graph core
+(``core.py``) and five passes:
+
+- ``trace-purity`` — no host side-effects (spans, metrics, locks,
+  ``time.*``, IO, ``print``) reachable inside jit'd/shard_map'd/Pallas
+  code (PR 6's "spans never open inside jit'd code" claim);
+- ``lock-order`` — no cycles in the interprocedural lock-acquisition
+  graph, no blocking RPC/subprocess calls under a held lock (the PR 5
+  ``HostSpillLedger`` finalizer-deadlock class);
+- ``recompile`` — no unhashable arguments into ``lru_cache``'d program
+  builders, no Python ``if`` on traced values inside jit'd functions,
+  no session-property reads inside cached builders (the PR 5
+  ``min_collectives`` stale-cache class);
+- ``session-props`` — every property looked up against the registry is
+  declared, every declared property has a read site, declared types
+  come from the registry vocabulary;
+- ``taxonomy`` — in ``parallel/``, no bare ``raise RuntimeError`` /
+  ``raise Exception`` and no broad ``except Exception`` handlers that
+  swallow without routing through ``parallel/fault.py``.
+
+Checked-in suppressions live in ``analysis_baseline.json`` at the repo
+root (pre-existing, triaged findings only — the file may only shrink);
+line-level opt-outs use ``# qlint: ignore[<pass>] <reason>`` for
+effects that are deliberate (e.g. trace-time-only counters).
+
+CLI: ``python -m trino_tpu.analysis [--json] [--passes a,b] [path]``.
+Tier-1 gate: ``tests/test_static_analysis.py`` runs every pass over
+``trino_tpu/`` and fails on any non-baselined finding.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Iterable, List, Optional
+
+from .core import Finding, ProjectIndex
+
+__all__ = ["Finding", "ProjectIndex", "PASSES", "run_passes",
+           "load_baseline", "apply_baseline", "default_baseline_path"]
+
+
+def _pass_trace_purity(index):
+    from .trace_purity import run
+    return run(index)
+
+
+def _pass_lock_order(index):
+    from .lock_order import run
+    return run(index)
+
+
+def _pass_recompile(index):
+    from .recompile import run
+    return run(index)
+
+
+def _pass_session_props(index):
+    from .session_props import run
+    return run(index)
+
+
+def _pass_taxonomy(index):
+    from .taxonomy import run
+    return run(index)
+
+
+#: pass slug -> runner(index) -> List[Finding]; slugs are the names
+#: used by --passes, pragmas and baseline keys
+PASSES = {
+    "trace-purity": _pass_trace_purity,
+    "lock-order": _pass_lock_order,
+    "recompile": _pass_recompile,
+    "session-props": _pass_session_props,
+    "taxonomy": _pass_taxonomy,
+}
+
+
+def run_passes(index: ProjectIndex,
+               passes: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Run the selected passes (all by default) and return pragma-
+    filtered findings, stable-sorted for deterministic output."""
+    selected = list(passes) if passes is not None else list(PASSES)
+    unknown = [p for p in selected if p not in PASSES]
+    if unknown:
+        raise ValueError(f"unknown passes {unknown}; "
+                         f"expected from {sorted(PASSES)}")
+    findings: List[Finding] = []
+    for name in selected:
+        for f in PASSES[name](index):
+            if not index.suppressed(f.module, f.line, f.pass_id):
+                findings.append(f)
+    findings.sort(key=lambda f: (f.module, f.line, f.pass_id, f.rule,
+                                 f.subject))
+    return findings
+
+
+def default_baseline_path(package_path: str) -> str:
+    """``analysis_baseline.json`` next to the scanned package (the repo
+    root for ``trino_tpu/``)."""
+    return os.path.join(os.path.dirname(os.path.abspath(package_path)),
+                        "analysis_baseline.json")
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """baseline key -> triage note. Missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        data = json.load(f)
+    out: Dict[str, str] = {}
+    for entry in data.get("findings", ()):
+        out[entry["key"]] = entry.get("note", "")
+    return out
+
+
+def apply_baseline(findings: List[Finding], baseline: Dict[str, str]):
+    """Split findings into (new, suppressed, stale_keys).
+
+    ``stale_keys`` are baseline entries that no longer fire — the
+    baseline is only allowed to shrink, so the gate reports them for
+    removal instead of letting dead suppressions accumulate."""
+    new: List[Finding] = []
+    suppressed: List[Finding] = []
+    fired = set()
+    for f in findings:
+        if f.key in baseline:
+            fired.add(f.key)
+            suppressed.append(f)
+        else:
+            new.append(f)
+    stale = sorted(k for k in baseline if k not in fired)
+    return new, suppressed, stale

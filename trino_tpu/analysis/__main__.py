@@ -1,0 +1,119 @@
+"""``python -m trino_tpu.analysis`` — run qlint over a package.
+
+Exit codes: 0 clean (every finding baselined), 1 non-baselined
+findings OR stale baseline entries (the baseline may only shrink),
+2 usage error. The analysis package itself is pure stdlib ``ast``
+(never imports the analyzed code or JAX); note that ``-m`` entry
+pays the PARENT package's ``import jax`` — a context where that
+could hang (the bench parent) must load this package by file path
+instead (see bench.py ``_load_qlint``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (PASSES, ProjectIndex, apply_baseline, default_baseline_path,
+               load_baseline, run_passes)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m trino_tpu.analysis",
+        description="qlint: repo-native static analysis "
+                    "(trace-purity, lock-order, recompile, "
+                    "session-props, taxonomy)")
+    parser.add_argument("path", nargs="?", default=None,
+                        help="package directory to analyze "
+                             "(default: the trino_tpu package)")
+    parser.add_argument("--passes", default=None,
+                        help="comma-separated pass subset "
+                             f"(default: all of {','.join(PASSES)})")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable JSON on stdout")
+    parser.add_argument("--baseline", default=None,
+                        help="suppression file "
+                             "(default: analysis_baseline.json next "
+                             "to the scanned package)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignore the "
+                             "baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="bootstrap/retriage: write ALL current "
+                             "findings to the baseline file (each "
+                             "entry still needs a hand-written triage "
+                             "note before it is reviewable)")
+    args = parser.parse_args(argv)
+
+    package_path = args.path or os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(package_path):
+        print(f"not a directory: {package_path}", file=sys.stderr)
+        return 2
+    passes = None
+    if args.passes:
+        passes = [p.strip() for p in args.passes.split(",") if p.strip()]
+        unknown = [p for p in passes if p not in PASSES]
+        if unknown:
+            print(f"unknown passes: {', '.join(unknown)} "
+                  f"(expected from {', '.join(PASSES)})",
+                  file=sys.stderr)
+            return 2
+        if args.write_baseline:
+            # a subset run would rewrite the file WITHOUT the other
+            # passes' triaged entries — silently destroying them
+            print("--write-baseline requires a full run "
+                  "(drop --passes)", file=sys.stderr)
+            return 2
+
+    index = ProjectIndex.from_package(package_path)
+    findings = run_passes(index, passes)
+
+    baseline_path = args.baseline or default_baseline_path(package_path)
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new, suppressed, stale = apply_baseline(findings, baseline)
+
+    if args.write_baseline:
+        # preserve existing triage notes even under --no-baseline
+        # (which only affects reporting, not the file's contents)
+        notes = load_baseline(baseline_path)
+        payload = {"comment": "qlint suppressions — pre-existing "
+                              "findings only; this file may only "
+                              "shrink",
+                   "findings": [{"key": f.key,
+                                 "note": notes.get(f.key,
+                                                   "TODO: triage")}
+                                for f in findings]}
+        with open(baseline_path, "w") as fh:
+            json.dump(payload, fh, indent=1, sort_keys=False)
+            fh.write("\n")
+        print(f"wrote {len(findings)} entries to {baseline_path}",
+              file=sys.stderr)
+
+    if args.json:
+        print(json.dumps({
+            "package": package_path,
+            "passes": passes or list(PASSES),
+            "new": [f.to_dict() for f in new],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "stale_baseline_keys": stale,
+        }, indent=1))
+    else:
+        for f in new:
+            print(f.render())
+        for key in stale:
+            print(f"STALE baseline entry no longer fires "
+                  f"(remove it): {key}")
+        print(f"qlint: {len(new)} finding(s), "
+              f"{len(suppressed)} baselined, {len(stale)} stale "
+              f"baseline entr{'y' if len(stale) == 1 else 'ies'} "
+              f"over {len(index.modules)} modules",
+              file=sys.stderr)
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,457 @@
+"""Shared static-analysis core: module index, function table, call
+graph, pragma handling.
+
+Everything is stdlib ``ast`` over source text — the analyzed package is
+never imported, so the analyzer runs without JAX (and cannot be fooled
+by import-time machinery). Resolution is deliberately conservative:
+only unambiguous targets (same-scope names, ``self.`` methods on the
+enclosing class, imported-module attributes, annotated parameters)
+resolve to call-graph edges; everything else stays a raw dotted chain
+for pattern-based checks. Over-approximating the graph would flood the
+purity/lock passes with false paths, under-approximating loses real
+ones — unambiguous-only is the tested middle ground, and the fixture
+tests in ``tests/test_static_analysis.py`` pin what each pass must
+still catch through it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    pass_id: str
+    rule: str
+    module: str          # dotted module name
+    qualname: str        # enclosing function ("" = module level)
+    line: int
+    message: str
+    subject: str         # stable discriminator (no line numbers)
+
+    @property
+    def key(self) -> str:
+        """Stable baseline key — no line numbers, so unrelated edits
+        don't churn the baseline."""
+        return (f"{self.pass_id}:{self.rule}:{self.module}:"
+                f"{self.qualname or '<module>'}:{self.subject}")
+
+    def render(self) -> str:
+        return (f"{self.module}:{self.line} [{self.pass_id}/{self.rule}] "
+                f"{(self.qualname + ': ') if self.qualname else ''}"
+                f"{self.message}")
+
+    def to_dict(self) -> dict:
+        return {"pass": self.pass_id, "rule": self.rule,
+                "module": self.module, "qualname": self.qualname,
+                "line": self.line, "message": self.message,
+                "key": self.key}
+
+
+@dataclass
+class CallSite:
+    chain: str                   # dotted source text of the callee
+    node: ast.Call
+    target: Optional[str] = None  # resolved function id, if unambiguous
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+@dataclass
+class FunctionInfo:
+    module: str
+    qualname: str                # Class.method / func / outer.inner
+    node: ast.AST                # FunctionDef | AsyncFunctionDef | Lambda
+    class_name: Optional[str]
+    scope: str                   # enclosing scope qualname ("" = module)
+    params: List[str] = field(default_factory=list)
+    #: parameter name -> annotated class name (string), best-effort
+    annotations: Dict[str, str] = field(default_factory=dict)
+    decorators: List[ast.expr] = field(default_factory=list)
+    calls: List[CallSite] = field(default_factory=list)
+
+    @property
+    def id(self) -> str:
+        return f"{self.module}:{self.qualname}"
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+    @property
+    def body(self) -> list:
+        return self.node.body
+
+
+_PRAGMA_RE = re.compile(r"#\s*qlint:\s*ignore\[([a-z*,\s-]+)\]")
+
+
+class ModuleInfo:
+    def __init__(self, name: str, source: str, path: str = "<memory>",
+                 is_package: bool = False):
+        self.name = name
+        self.path = path
+        #: True for a package __init__: its relative imports resolve
+        #: against the package itself, not the parent
+        self.is_package = is_package
+        self.tree = ast.parse(source, filename=path)
+        #: alias -> dotted module (``import a.b as c`` and
+        #: ``from pkg import mod`` both land here when mod is a module)
+        self.imports: Dict[str, str] = {}
+        #: name -> (dotted module, original name) for from-imports
+        self.from_imports: Dict[str, Tuple[str, str]] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        #: names bound by module-level assignments (not defs/imports)
+        self.module_assigns: Set[str] = set()
+        for stmt in self.tree.body:
+            targets = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self.module_assigns.add(t.id)
+        #: scope qualname -> {visible def name -> qualname}
+        self.scopes: Dict[str, Dict[str, str]] = {"": {}}
+        #: class name -> {method name -> qualname}
+        self.classes: Dict[str, Dict[str, str]] = {}
+        #: line -> set of pass slugs suppressed there
+        self.pragmas: Dict[int, Set[str]] = {}
+        for i, text in enumerate(source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(text)
+            if m:
+                self.pragmas[i] = {p.strip()
+                                   for p in m.group(1).split(",")}
+        self._collect()
+
+    # -- collection ------------------------------------------------------
+
+    def _collect(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.imports[alias.asname or
+                                 alias.name.split(".")[0]] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                target = self._resolve_from(node)
+                if target is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    self.from_imports[alias.asname or alias.name] = \
+                        (target, alias.name)
+        self._walk_scope(self.tree.body, scope="", class_name=None)
+
+    def enclosing_function(self, line: int) -> Optional["FunctionInfo"]:
+        """Innermost function whose def spans ``line`` (None = module
+        level) — shared by every pass that anchors a finding to its
+        enclosing function."""
+        best = None
+        for info in self.functions.values():
+            node = info.node
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                if best is None or node.lineno > best.node.lineno:
+                    best = info
+        return best
+
+    def _resolve_from(self, node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            return node.module
+        # relative import: strip ``level`` trailing components from
+        # this module's dotted name. A leaf module's level=1 is its
+        # package; a package __init__'s level=1 is the package ITSELF
+        # (model it as a phantom leaf)
+        parts = self.name.split(".")
+        if self.is_package:
+            parts = parts + ["__init__"]
+        if node.level > len(parts):
+            return None
+        base = parts[:len(parts) - node.level]
+        if node.module:
+            base.append(node.module)
+        return ".".join(base)
+
+    def _walk_scope(self, body: Sequence[ast.stmt], scope: str,
+                    class_name: Optional[str]):
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{scope}.{stmt.name}" if scope else stmt.name
+                info = FunctionInfo(self.name, qual, stmt, class_name,
+                                    scope)
+                info.params = [a.arg for a in
+                               stmt.args.posonlyargs + stmt.args.args
+                               + stmt.args.kwonlyargs]
+                for a in stmt.args.posonlyargs + stmt.args.args \
+                        + stmt.args.kwonlyargs:
+                    ann = _annotation_name(a.annotation)
+                    if ann:
+                        info.annotations[a.arg] = ann
+                info.decorators = list(stmt.decorator_list)
+                info.calls = _collect_calls(stmt)
+                self.functions[qual] = info
+                self.scopes.setdefault(scope, {})[stmt.name] = qual
+                if class_name is not None and scope == class_name:
+                    self.classes.setdefault(class_name, {})[stmt.name] \
+                        = qual
+                # nested defs live in the function's scope; a method's
+                # class context does not extend to its inner functions
+                self._walk_scope(stmt.body, qual, None)
+            elif isinstance(stmt, ast.ClassDef):
+                self.classes.setdefault(stmt.name, {})
+                self.scopes.setdefault(scope, {})[stmt.name] = stmt.name
+                self._walk_scope(stmt.body, stmt.name, stmt.name)
+            elif isinstance(stmt, (ast.If, ast.Try, ast.With, ast.For,
+                                   ast.While)):
+                # statements nested in control flow at any scope
+                for field_name in ("body", "orelse", "finalbody"):
+                    self._walk_scope(getattr(stmt, field_name, []) or [],
+                                     scope, class_name)
+                for handler in getattr(stmt, "handlers", []) or []:
+                    self._walk_scope(handler.body, scope, class_name)
+
+
+def _annotation_name(node) -> Optional[str]:
+    if node is None:
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value.split(".")[-1].strip('"')
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def dotted_chain(node) -> Optional[str]:
+    """``a.b.c`` source chain for a Name/Attribute expression, or None
+    when the base is a call/subscript (unresolvable)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _collect_calls(func_node) -> List[CallSite]:
+    """Every Call in the function body, EXCLUDING nested function
+    bodies (those get their own FunctionInfo)."""
+    calls: List[CallSite] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node: ast.Call):
+            chain = dotted_chain(node.func)
+            if chain is not None:
+                calls.append(CallSite(chain, node))
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            if node is not func_node:
+                return  # nested def: its calls belong to it
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            # lambdas stay attributed to the enclosing function: they
+            # are deferred but almost always invoked from this frame
+            self.generic_visit(node)
+
+    V().visit(func_node)
+    return calls
+
+
+class ProjectIndex:
+    """All modules of one package, with cross-module call resolution."""
+
+    def __init__(self, modules: Dict[str, ModuleInfo]):
+        self.modules = modules
+        self.functions: Dict[str, FunctionInfo] = {}
+        for mod in modules.values():
+            for info in mod.functions.values():
+                self.functions[info.id] = info
+        for mod in modules.values():
+            for info in mod.functions.values():
+                for call in info.calls:
+                    call.target = self.resolve(mod, info, call.chain)
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def from_package(cls, package_path: str,
+                     package_name: Optional[str] = None,
+                     exclude: Sequence[str] = ("analysis",)
+                     ) -> "ProjectIndex":
+        """Index every .py under ``package_path``. ``exclude`` names
+        top-level subpackages to skip (the analyzer does not analyze
+        itself by default — it would only find its own pattern
+        tables)."""
+        package_path = os.path.abspath(package_path)
+        if package_name is None:
+            package_name = os.path.basename(package_path.rstrip("/"))
+        modules: Dict[str, ModuleInfo] = {}
+        for root, dirs, files in os.walk(package_path):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__"
+                             and not (root == package_path
+                                      and d in exclude))
+            for fn in sorted(files):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(root, fn)
+                rel = os.path.relpath(path, package_path)
+                parts = rel[:-3].split(os.sep)
+                is_package = parts[-1] == "__init__"
+                if is_package:
+                    parts = parts[:-1]
+                name = ".".join([package_name] + parts) if parts \
+                    else package_name
+                with open(path, encoding="utf-8") as f:
+                    modules[name] = ModuleInfo(name, f.read(), path,
+                                               is_package=is_package)
+        return cls(modules)
+
+    @classmethod
+    def from_sources(cls, sources: Dict[str, str],
+                     packages: Sequence[str] = ()) -> "ProjectIndex":
+        """Fixture entry: {dotted module name: source text};
+        ``packages`` names entries that model a package __init__."""
+        return cls({name: ModuleInfo(name, src,
+                                     is_package=name in packages)
+                    for name, src in sources.items()})
+
+    # -- resolution ------------------------------------------------------
+
+    def resolve(self, mod: ModuleInfo, info: Optional[FunctionInfo],
+                chain: str) -> Optional[str]:
+        """Resolve a dotted call chain to a function id, or None."""
+        parts = chain.split(".")
+        head = parts[0]
+        if head in ("self", "cls") and info is not None \
+                and info.class_name and len(parts) == 2:
+            return self._method(mod.name, info.class_name, parts[1])
+        if len(parts) == 1:
+            return self._resolve_bare(mod, info, head)
+        # annotated parameter: other._lock-style method calls
+        if info is not None and head in info.annotations \
+                and len(parts) == 2:
+            hit = self._method_anywhere(mod, info.annotations[head],
+                                        parts[1])
+            if hit:
+                return hit
+        # local or imported class attribute: Class.method
+        if len(parts) == 2:
+            hit = self._method_anywhere(mod, head, parts[1])
+            if hit:
+                return hit
+        # imported module attribute: mod.func / pkg.mod.func
+        for split in range(len(parts) - 1, 0, -1):
+            target_mod = self._module_for(mod, parts[:split])
+            if target_mod is None:
+                continue
+            rest = ".".join(parts[split:])
+            target = self.modules.get(target_mod)
+            if target is not None and rest in target.functions:
+                return f"{target_mod}:{rest}"
+        return None
+
+    def _resolve_bare(self, mod: ModuleInfo, info: Optional[FunctionInfo],
+                      name: str) -> Optional[str]:
+        # nearest enclosing scope first: nested defs shadow module level
+        if info is not None:
+            scope = info.qualname
+            while True:
+                # class scopes do not participate in bare-name
+                # resolution (Python binds `helper()` in a method to
+                # the module-level helper, never the sibling method)
+                if scope not in mod.classes:
+                    names = mod.scopes.get(scope, {})
+                    if name in names and names[name] in mod.functions:
+                        return f"{mod.name}:{names[name]}"
+                if not scope:
+                    break
+                scope = scope.rsplit(".", 1)[0] if "." in scope else ""
+        elif name in mod.scopes.get("", {}):
+            qual = mod.scopes[""][name]
+            if qual in mod.functions:
+                return f"{mod.name}:{qual}"
+        if name in mod.from_imports:
+            target_mod, orig = mod.from_imports[name]
+            target = self.modules.get(target_mod)
+            if target is not None and orig in target.functions:
+                return f"{target_mod}:{orig}"
+        return None
+
+    def _method(self, module: str, class_name: str,
+                method: str) -> Optional[str]:
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        qual = mod.classes.get(class_name, {}).get(method)
+        return f"{module}:{qual}" if qual else None
+
+    def _method_anywhere(self, mod: ModuleInfo, class_name: str,
+                         method: str) -> Optional[str]:
+        hit = self._method(mod.name, class_name, method)
+        if hit:
+            return hit
+        if class_name in mod.from_imports:
+            target_mod, orig = mod.from_imports[class_name]
+            return self._method(target_mod, orig, method)
+        return None
+
+    def _module_for(self, mod: ModuleInfo,
+                    parts: Sequence[str]) -> Optional[str]:
+        head = parts[0]
+        base = None
+        if head in mod.imports:
+            base = mod.imports[head]
+        elif head in mod.from_imports:
+            target_mod, orig = mod.from_imports[head]
+            candidate = f"{target_mod}.{orig}"
+            if candidate in self.modules:
+                base = candidate
+            elif target_mod in self.modules and orig not in \
+                    self.modules[target_mod].functions:
+                return None
+        if base is None:
+            return None
+        full = ".".join([base] + list(parts[1:]))
+        if full in self.modules:
+            return full
+        # single-part chains keep their mapped module even when it is
+        # external (callers None-check membership themselves)
+        return base if len(parts) == 1 else None
+
+    # -- queries ---------------------------------------------------------
+
+    def iter_functions(self) -> Iterator[FunctionInfo]:
+        for mod_name in sorted(self.modules):
+            mod = self.modules[mod_name]
+            for qual in sorted(mod.functions):
+                yield mod.functions[qual]
+
+    def suppressed(self, module: str, line: int, pass_id: str) -> bool:
+        mod = self.modules.get(module)
+        if mod is None:
+            return False
+        passes = mod.pragmas.get(line)
+        return bool(passes) and (pass_id in passes or "*" in passes)
+
+    def decorator_chain(self, dec: ast.expr) -> Optional[str]:
+        """Dotted chain of a decorator expression; for a decorator
+        CALL (``@partial(jax.jit, ...)``) the called chain."""
+        if isinstance(dec, ast.Call):
+            return dotted_chain(dec.func)
+        return dotted_chain(dec)

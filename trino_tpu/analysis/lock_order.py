@@ -1,0 +1,309 @@
+"""lock-order pass: AB-BA cycles and blocking calls under a held lock.
+
+Lock identity is structural: ``with self._lock:`` in a method of class
+``C`` in module ``M`` names the lock ``M.C._lock``; module-level locks
+name ``M.<name>``; locals/parameters stay scoped to their function (no
+cross-function aliasing is assumed, so they can never fabricate a
+cycle). While a lock is lexically held, every further acquisition —
+in the same body or transitively through resolved call-graph edges —
+adds an edge to the acquisition-order graph; a cycle in that graph is
+the PR 5 ``HostSpillLedger`` finalizer-deadlock class. Self-edges are
+reported only for locks constructed as ``threading.Lock()`` (an RLock
+re-entering itself is fine and the spill ledger does exactly that).
+
+Non-blocking tries (``acquire(blocking=False)``) are excluded
+everywhere: they cannot wait, so they can neither close a cycle nor
+stall an RPC — ``demote_across``'s cross-list lock hops rely on this.
+
+A second rule flags blocking RPC / subprocess / socket traffic while
+holding any lock (``lock-over-rpc``): the PR 3 worker-loss detector
+turns a worker stuck on a peer into a cascading replacement storm if
+its server threads serialize behind a lock held across the wire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, FunctionInfo, ModuleInfo, ProjectIndex,
+                   dotted_chain)
+
+PASS_ID = "lock-order"
+
+_RPC_PREFIXES = ("subprocess.", "socket.")
+_RPC_LASTS = {"send_msg", "recv_msg", "check_output", "check_call"}
+_RPC_TARGET_SUFFIXES = (":call",)   # trino_tpu.parallel.rpc:call
+
+
+def _lockish(chain: Optional[str]) -> bool:
+    return bool(chain) and "lock" in chain.split(".")[-1].lower()
+
+
+def _lock_id(mod: ModuleInfo, func: Optional[FunctionInfo],
+             chain: str) -> str:
+    parts = chain.split(".")
+    if parts[0] in ("self", "cls") and func is not None:
+        owner = func.class_name or func.qualname
+        return f"{mod.name}.{owner}.{'.'.join(parts[1:])}"
+    if len(parts) == 1 and func is not None \
+            and parts[0] not in mod.module_assigns \
+            and parts[0] not in mod.scopes.get("", {}) \
+            and parts[0] not in mod.from_imports:
+        # local or parameter: scope to the function so distinct
+        # callers' locks never unify into a false shared node
+        return f"{mod.name}:{func.qualname}.{parts[0]}"
+    return f"{mod.name}.{chain}"
+
+
+def _collect_lock_kinds(index: ProjectIndex) -> Dict[str, str]:
+    """lock id -> 'lock' | 'rlock' from ``X = threading.(R)Lock()``
+    construction sites."""
+    kinds: Dict[str, str] = {}
+    for mod in index.modules.values():
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) \
+                    or not isinstance(node.value, ast.Call):
+                continue
+            ctor = dotted_chain(node.value.func)
+            if ctor not in ("threading.Lock", "threading.RLock",
+                            "Lock", "RLock"):
+                continue
+            kind = "rlock" if ctor.endswith("RLock") else "lock"
+            for t in node.targets:
+                chain = dotted_chain(t)
+                if chain is None:
+                    continue
+                func = mod.enclosing_function(node.lineno)
+                kinds[_lock_id(mod, func, chain)] = kind
+    return kinds
+
+
+def _nonblocking(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "blocking" and isinstance(kw.value, ast.Constant):
+            return kw.value.value is False
+    return False
+
+
+class _FuncLocks(ast.NodeVisitor):
+    """One function's lock behaviour: direct acquisitions, ordered
+    edges, calls made under a lock, RPC-ish calls under a lock."""
+
+    def __init__(self, index: ProjectIndex, mod: ModuleInfo,
+                 func: FunctionInfo):
+        self.index = index
+        self.mod = mod
+        self.func = func
+        self.acquired: Set[str] = set()
+        self.edges: List[Tuple[str, str, int]] = []
+        #: (held lock, resolved target, line, call was via ``self.``)
+        self.calls_under: List[Tuple[str, str, int, bool]] = []
+        self.rpc_under: List[Tuple[str, str, int]] = []     # (lock, chain, line)
+        self._held: List[str] = []
+
+    def _acquire(self, lock: str, line: int):
+        self.acquired.add(lock)
+        for held in self._held:
+            self.edges.append((held, lock, line))
+
+    def visit_With(self, node: ast.With):
+        pushed = 0
+        for item in node.items:
+            chain = dotted_chain(item.context_expr)
+            if _lockish(chain):
+                lock = _lock_id(self.mod, self.func, chain)
+                self._acquire(lock, node.lineno)
+                self._held.append(lock)
+                pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(pushed):
+            self._held.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call):
+        chain = dotted_chain(node.func)
+        if chain is not None:
+            parts = chain.split(".")
+            if parts[-1] == "acquire" and len(parts) > 1 \
+                    and not _nonblocking(node):
+                lock = _lock_id(self.mod, self.func,
+                                ".".join(parts[:-1]))
+                self._acquire(lock, node.lineno)
+            elif self._held:
+                target = self.index.resolve(self.mod, self.func, chain)
+                if target is not None:
+                    via_self = parts[0] in ("self", "cls")
+                    for held in self._held:
+                        self.calls_under.append(
+                            (held, target, node.lineno, via_self))
+                if self._rpcish(chain, target):
+                    for held in self._held:
+                        self.rpc_under.append(
+                            (held, chain, node.lineno))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _rpcish(chain: str, target: Optional[str]) -> bool:
+        if target and target.endswith(_RPC_TARGET_SUFFIXES):
+            return True
+        return chain.startswith(_RPC_PREFIXES) \
+            or chain.split(".")[-1] in _RPC_LASTS
+
+    def visit_FunctionDef(self, node):
+        if node is not self.func.node:
+            return
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _transitive_acquisitions(per_func: Dict[str, "_FuncLocks"],
+                             index: ProjectIndex
+                             ) -> Dict[str, Set[str]]:
+    trans = {fid: set(fl.acquired) for fid, fl in per_func.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid, fl in per_func.items():
+            cur = trans[fid]
+            before = len(cur)
+            for call in index.functions[fid].calls:
+                if call.target in trans:
+                    cur |= trans[call.target]
+            if len(cur) != before:
+                changed = True
+    return trans
+
+
+def _cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Strongly connected components with >1 node, canonically
+    rotated; self-loop filtering happens at the caller (RLocks)."""
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Set[str] = set()
+    stack: List[str] = []
+    out: List[List[str]] = []
+    counter = [0]
+
+    def strong(v: str):
+        worklist = [(v, iter(sorted(graph.get(v, ()))))]
+        idx[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        while worklist:
+            node, it = worklist[-1]
+            advanced = False
+            for w in it:
+                if w not in graph:
+                    continue
+                if w not in idx:
+                    idx[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on.add(w)
+                    worklist.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on:
+                    low[node] = min(low[node], idx[w])
+            if advanced:
+                continue
+            worklist.pop()
+            if worklist:
+                parent = worklist[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == idx[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) > 1:
+                    pivot = min(comp)
+                    i = comp.index(pivot)
+                    out.append(comp[i:] + comp[:i])
+
+    for v in sorted(graph):
+        if v not in idx:
+            strong(v)
+    return out
+
+
+def run(index: ProjectIndex) -> List[Finding]:
+    per_func: Dict[str, _FuncLocks] = {}
+    for func in index.iter_functions():
+        mod = index.modules[func.module]
+        fl = _FuncLocks(index, mod, func)
+        for stmt in func.body:
+            fl.visit(stmt)
+        per_func[func.id] = fl
+
+    trans = _transitive_acquisitions(per_func, index)
+    kinds = _collect_lock_kinds(index)
+
+    graph: Dict[str, Set[str]] = {}
+    edge_site: Dict[Tuple[str, str], Tuple[str, str, int]] = {}
+
+    def add_edge(a: str, b: str, func: FunctionInfo, line: int):
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+        edge_site.setdefault((a, b), (func.module, func.qualname, line))
+
+    findings: List[Finding] = []
+    for fid, fl in per_func.items():
+        func = index.functions[fid]
+        for a, b, line in fl.edges:
+            if a == b:
+                if kinds.get(a, "rlock") == "lock":
+                    findings.append(Finding(
+                        PASS_ID, "self-deadlock", func.module,
+                        func.qualname, line,
+                        f"non-reentrant lock `{a}` re-acquired while "
+                        f"held (threading.Lock deadlocks on itself)",
+                        f"self:{a}"))
+                continue
+            add_edge(a, b, func, line)
+        for held, target, line, via_self in fl.calls_under:
+            for b in trans.get(target, ()):
+                if b != held:
+                    add_edge(held, b, func, line)
+            # re-acquiring the held lock through a method of the SAME
+            # instance (``self.``-routed, so the lock objects cannot
+            # differ) deadlocks a non-reentrant Lock; cross-instance
+            # calls are excluded — structural identity would conflate
+            # two objects' locks into a false self-cycle
+            callee = per_func.get(target)
+            if via_self and callee is not None \
+                    and held in callee.acquired \
+                    and kinds.get(held, "rlock") == "lock":
+                findings.append(Finding(
+                    PASS_ID, "self-deadlock", func.module,
+                    func.qualname, line,
+                    f"calls `{target.split(':')[-1]}` which "
+                    f"re-acquires held non-reentrant `{held}` "
+                    f"(threading.Lock deadlocks on itself)",
+                    f"self:{held}"))
+        for held, chain, line in fl.rpc_under:
+            findings.append(Finding(
+                PASS_ID, "lock-over-rpc", func.module, func.qualname,
+                line,
+                f"blocking call `{chain}()` while holding `{held}`: "
+                f"a slow peer stalls every thread behind this lock",
+                f"rpc:{held}:{chain}"))
+
+    for comp in _cycles(graph):
+        mod_name, qual, line = edge_site.get(
+            (comp[0], comp[1] if len(comp) > 1 else comp[0]),
+            (comp[0].split(":")[0].rsplit(".", 1)[0], "", 1))
+        cyc = " -> ".join(comp + [comp[0]])
+        findings.append(Finding(
+            PASS_ID, "lock-cycle", mod_name, qual, line,
+            f"lock acquisition cycle: {cyc} (AB-BA deadlock when the "
+            f"orders interleave)", f"cycle:{'|'.join(comp)}"))
+    return findings

@@ -1,0 +1,149 @@
+"""recompile pass: hazards that defeat program-cache reuse.
+
+Three rules, all instances of one failure mode — the cache key and the
+traced program disagree, so the engine either retraces per page
+(interpreter-speed slide, the classic silent JAX perf bug) or serves a
+stale compiled program:
+
+- ``unhashable-arg``: a dict/list/set display (or comprehension)
+  flowing into an ``lru_cache``'d builder call — the call raises
+  ``TypeError: unhashable`` at runtime, or the caller "fixes" it by
+  rebuilding per call and the cache silently never hits.
+- ``traced-branch``: Python ``if``/``while`` on a non-static parameter
+  inside a jit'd function — branching on a traced value either raises
+  ``TracerBoolConversionError`` or, with shape-dependent guards,
+  retraces per distinct outcome. Attribute guards on ``.shape`` /
+  ``.dtype`` / ``.ndim`` / ``len()`` are static and exempt.
+- ``cached-builder-reads-session``: a session-property read inside an
+  ``lru_cache``'d builder whose value is not part of the cache key —
+  the first call bakes one setting into the memoized program and later
+  sessions silently get it (the PR 5 ``min_collectives`` bug: fixed by
+  hoisting the read into the cache-key parameters).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, FunctionInfo, ProjectIndex, dotted_chain
+from .trace_purity import jit_entries
+
+PASS_ID = "recompile"
+
+_CACHE_CHAINS = {"lru_cache", "functools.lru_cache", "cache",
+                 "functools.cache"}
+_SESSION_READ_LASTS = {"value", "prop_value"}
+_UNHASHABLE = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
+               ast.SetComp, ast.GeneratorExp)
+_STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+
+def _cached_functions(index: ProjectIndex) -> Dict[str, FunctionInfo]:
+    out: Dict[str, FunctionInfo] = {}
+    for func in index.iter_functions():
+        for dec in func.decorators:
+            if index.decorator_chain(dec) in _CACHE_CHAINS:
+                out[func.id] = func
+    return out
+
+
+def _dynamic_param_refs(test: ast.expr, params: Set[str]) -> List[str]:
+    """Parameter names referenced in ``test`` other than through
+    static accessors (``x.shape[0]``, ``len(x)``, ``x is None``)."""
+    static_ids: Set[int] = set()
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute) \
+                and node.attr in _STATIC_ATTRS:
+            for inner in ast.walk(node.value):
+                static_ids.add(id(inner))
+        elif isinstance(node, ast.Call):
+            chain = dotted_chain(node.func)
+            if chain in ("len", "isinstance", "type", "getattr",
+                         "hasattr"):
+                for arg in node.args:
+                    for inner in ast.walk(arg):
+                        static_ids.add(id(inner))
+        elif isinstance(node, ast.Compare) and any(
+                isinstance(op, (ast.Is, ast.IsNot))
+                for op in node.ops):
+            for inner in ast.walk(node):
+                static_ids.add(id(inner))
+    hits = []
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in params \
+                and id(node) not in static_ids:
+            hits.append(node.id)
+    return hits
+
+
+def run(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    cached = _cached_functions(index)
+
+    # (a) unhashable arguments into cached builders
+    for func in index.iter_functions():
+        for call in func.calls:
+            if call.target not in cached:
+                continue
+            builder = cached[call.target]
+            exprs = list(call.node.args) \
+                + [kw.value for kw in call.node.keywords]
+            for e in exprs:
+                if isinstance(e, _UNHASHABLE):
+                    findings.append(Finding(
+                        PASS_ID, "unhashable-arg", func.module,
+                        func.qualname, e.lineno,
+                        f"dict/list/set argument into lru_cache'd "
+                        f"`{builder.qualname}` — unhashable cache "
+                        f"key (pass a tuple / frozen value)",
+                        f"unhashable:{builder.qualname}"))
+
+    # (b) Python branches on traced (non-static) parameters
+    for entry in jit_entries(index).values():
+        func = entry.func
+        dynamic = set(func.params) - entry.static_params
+        if func.class_name:
+            dynamic.discard("self")
+        for node in ast.walk(func.node):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            refs = _dynamic_param_refs(node.test, dynamic)
+            for name in sorted(set(refs)):
+                findings.append(Finding(
+                    PASS_ID, "traced-branch", func.module,
+                    func.qualname, node.lineno,
+                    f"Python `{type(node).__name__.lower()}` on "
+                    f"traced parameter `{name}` inside jit'd "
+                    f"`{func.qualname}` — use lax.cond/jnp.where, "
+                    f"or declare it static",
+                    f"branch:{name}"))
+
+    # (c) session-property reads inside cached builders
+    for fid, builder in cached.items():
+        for call in builder.calls:
+            last = call.chain.split(".")[-1]
+            resolved = call.target or ""
+            is_read = resolved.endswith(
+                (":value", ":prop_value")) and \
+                "session_properties" in resolved
+            if not is_read and last in _SESSION_READ_LASTS:
+                head = call.chain.split(".")[0]
+                is_read = head in ("SP", "session_properties")
+            if not is_read:
+                continue
+            prop = ""
+            for a in call.node.args:
+                if isinstance(a, ast.Constant) \
+                        and isinstance(a.value, str):
+                    prop = a.value
+                    break
+            findings.append(Finding(
+                PASS_ID, "cached-builder-reads-session",
+                builder.module, builder.qualname, call.line,
+                f"lru_cache'd `{builder.qualname}` reads session "
+                f"property {prop or '<dynamic>'!r} not in its cache "
+                f"key — first caller's setting is baked into the "
+                f"memoized program",
+                f"session-read:{prop or call.chain}"))
+    return findings

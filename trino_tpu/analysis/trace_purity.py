@@ -1,0 +1,258 @@
+"""trace-purity pass: no host side-effects reachable inside traced code.
+
+Entry points are functions that jax stages out: ``@jax.jit`` /
+``@partial(jax.jit, ...)`` / ``@partial(shard_map, ...)`` decorated
+defs, and local functions passed into ``jax.jit(f)`` /
+``shard_map(f, ...)`` / ``pl.pallas_call(kernel, ...)`` call forms
+(the builder idiom of ``device_exchange._exchange_program`` and the
+``mesh_query`` programs). From every entry the pass walks resolved
+call-graph edges and flags host effects at any reachable function:
+span/metrics calls, lock acquisition, ``time.*``, file/socket/
+subprocess IO, ``print``, host-RNG, and subscript stores into traced
+parameters. The Python body of a jitted function runs only at trace
+time, so any such effect silently fires once per compile instead of
+once per call — or worse, holds a lock for the duration of a trace
+(PR 6's "spans never open inside jit'd code" claim, now checked).
+
+``jit_stats.bump`` is allowlisted: a trace-time counter is the
+documented mechanism that makes "repeat shapes do not retrace"
+assertable (one bump per cache miss, by design — see jit_stats.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (CallSite, Finding, FunctionInfo, ModuleInfo,
+                   ProjectIndex, dotted_chain)
+
+PASS_ID = "trace-purity"
+
+#: decorator / call chains that stage a Python function out to XLA
+_JIT_CHAINS = {"jax.jit", "jit", "jax.pmap", "pmap"}
+_SHARD_CHAINS = {"shard_map", "jax.experimental.shard_map.shard_map"}
+_PALLAS_SUFFIX = "pallas_call"
+_PARTIAL_CHAINS = {"partial", "functools.partial"}
+
+#: trace-time effects that are the designed mechanism, not a bug
+_ALLOWED_CALLS = {"jit_stats.bump"}
+
+
+@dataclass
+class EntryInfo:
+    """One staged-out function and how it was staged."""
+    func: FunctionInfo
+    kind: str                      # jit | shard_map | pallas
+    static_params: Set[str] = field(default_factory=set)
+
+
+def _static_names(call: ast.Call) -> Set[str]:
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            if isinstance(kw.value, (ast.Tuple, ast.List)):
+                return {e.value for e in kw.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+            if isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                return {kw.value.value}
+    return set()
+
+
+def _stage_kind(chain: Optional[str]) -> Optional[str]:
+    if chain is None:
+        return None
+    if chain in _JIT_CHAINS:
+        return "jit"
+    if chain in _SHARD_CHAINS or chain.split(".")[-1] == "shard_map":
+        return "shard_map"
+    if chain.split(".")[-1] == _PALLAS_SUFFIX:
+        return "pallas"
+    return None
+
+
+def jit_entries(index: ProjectIndex) -> Dict[str, EntryInfo]:
+    """Every staged-out function in the project, keyed by function id.
+    Shared with the recompile pass (traced-branch detection needs the
+    same entry set plus each entry's static parameter names)."""
+    entries: Dict[str, EntryInfo] = {}
+
+    def add(func: Optional[FunctionInfo], kind: str,
+            statics: Set[str]):
+        if func is not None and func.id not in entries:
+            entries[func.id] = EntryInfo(func, kind, statics)
+
+    for mod_name in sorted(index.modules):
+        mod = index.modules[mod_name]
+        for qual in sorted(mod.functions):
+            info = mod.functions[qual]
+            # decorator forms
+            for dec in info.decorators:
+                chain = index.decorator_chain(dec)
+                kind = _stage_kind(chain)
+                statics: Set[str] = set()
+                if kind is None and isinstance(dec, ast.Call) \
+                        and chain in _PARTIAL_CHAINS and dec.args:
+                    kind = _stage_kind(dotted_chain(dec.args[0]))
+                if kind is not None and isinstance(dec, ast.Call):
+                    statics = _static_names(dec)
+                if kind is not None:
+                    add(info, kind, statics)
+            # call forms: jax.jit(f) / shard_map(f, ...) /
+            # pl.pallas_call(kernel, ...)
+            for call in info.calls:
+                kind = _stage_kind(call.chain)
+                if kind is None or not call.node.args:
+                    continue
+                arg_chain = dotted_chain(call.node.args[0])
+                if arg_chain is None:
+                    continue
+                target = index.resolve(mod, info, arg_chain)
+                if target in index.functions:
+                    add(index.functions[target], kind,
+                        _static_names(call.node))
+    return entries
+
+
+# -- impurity tables -----------------------------------------------------
+
+_IO_EXACT = {"open", "input"}
+_IO_PREFIXES = ("os.", "socket.", "subprocess.", "shutil.", "io.")
+_TELEMETRY_LASTS = {"span", "counter", "gauge", "histogram",
+                    "gauge_fn", "observe"}
+
+
+def _classify_call(chain: str) -> Optional[Tuple[str, str]]:
+    """(rule, description) when the called chain is a host effect."""
+    if chain in _ALLOWED_CALLS:
+        return None
+    parts = chain.split(".")
+    last = parts[-1]
+    if chain == "print":
+        return "host-io", "print() runs once per trace, not per call"
+    if parts[0] == "time":
+        return "host-time", "time.* reads the host clock at trace time"
+    if chain in _IO_EXACT or chain.startswith(_IO_PREFIXES):
+        return "host-io", "file/socket/process IO inside traced code"
+    if last == "acquire" or (len(parts) > 1
+                             and "lock" in parts[-2].lower()):
+        return "lock-in-trace", ("lock acquisition inside traced code "
+                                 "(held for the whole trace, or never "
+                                 "per-call)")
+    if last in _TELEMETRY_LASTS and (
+            "tracer" in parts or "metrics" in parts
+            or parts[0] in ("tracer", "metrics")):
+        return "telemetry-in-trace", ("span/metric call inside traced "
+                                      "code fires per compile, not per "
+                                      "query")
+    if parts[0] in ("random",) or chain.startswith("np.random."):
+        return "host-rng", "host RNG draws once at trace time"
+    return None
+
+
+def _with_lockish(stmt: ast.With) -> Optional[str]:
+    for item in stmt.items:
+        chain = dotted_chain(item.context_expr)
+        if chain and "lock" in chain.split(".")[-1].lower():
+            return chain
+    return None
+
+
+def _param_store_targets(func: FunctionInfo) -> List[ast.AST]:
+    """Subscript stores into the function's own parameters —
+    ``arr[i] = x`` on a traced array mutates a host buffer at trace
+    time (jax arrays reject it; numpy ones silently bake one value
+    in)."""
+    params = set(func.params)
+    hits: List[ast.AST] = []
+    for node in ast.walk(func.node):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            if isinstance(t, ast.Subscript) \
+                    and isinstance(t.value, ast.Name) \
+                    and t.value.id in params:
+                hits.append(t)
+    return hits
+
+
+def run(index: ProjectIndex) -> List[Finding]:
+    entries = jit_entries(index)
+    findings: List[Finding] = []
+    # BFS per entry over resolved edges; remember one sample path root
+    reached_via: Dict[str, str] = {}   # function id -> entry id
+    order: List[str] = []
+    for fid in sorted(entries):
+        stack = [fid]
+        while stack:
+            cur = stack.pop()
+            if cur in reached_via:
+                continue
+            reached_via[cur] = fid
+            order.append(cur)
+            func = index.functions.get(cur)
+            if func is None:
+                continue
+            for call in func.calls:
+                # an allowlisted call's own body is its business
+                # (jit_stats.bump's counter lock is the mechanism)
+                if call.chain in _ALLOWED_CALLS:
+                    continue
+                if call.target and call.target in index.functions:
+                    stack.append(call.target)
+
+    seen: Set[Tuple[str, str]] = set()
+    for cur in order:
+        func = index.functions.get(cur)
+        if func is None:
+            continue
+        entry = entries[reached_via[cur]].func
+        via = "" if cur == entry.id \
+            else f" (reached from traced entry {entry.qualname})"
+        for call in func.calls:
+            hit = _classify_call(call.chain)
+            if hit is None:
+                continue
+            rule, why = hit
+            key = (cur, f"{rule}:{call.chain}")
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                PASS_ID, rule, func.module, func.qualname, call.line,
+                f"`{call.chain}()` inside traced code{via}: {why}",
+                f"{call.chain}"))
+        for node in ast.walk(func.node):
+            if isinstance(node, ast.With):
+                chain = _with_lockish(node)
+                if chain is None:
+                    continue
+                key = (cur, f"lock-in-trace:{chain}")
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    PASS_ID, "lock-in-trace", func.module,
+                    func.qualname, node.lineno,
+                    f"`with {chain}:` inside traced code{via}: the "
+                    f"lock is held at trace time only",
+                    f"with:{chain}"))
+        if cur in entries:
+            for t in _param_store_targets(func):
+                name = t.value.id  # type: ignore[attr-defined]
+                key = (cur, f"param-store:{name}")
+                if key in seen:
+                    continue
+                seen.add(key)
+                findings.append(Finding(
+                    PASS_ID, "host-mutation", func.module,
+                    func.qualname, t.lineno,
+                    f"subscript store into traced parameter "
+                    f"`{name}` mutates a host buffer at trace time",
+                    f"store:{name}"))
+    return findings

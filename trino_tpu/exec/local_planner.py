@@ -57,16 +57,15 @@ def grouping_options(props: Dict) -> Dict:
     builds its planners through this, so the sites cannot drift)."""
     from .. import session_properties as SP
 
-    def v(name):
-        return props.get(name, SP.REGISTRY[name].default)
-
     return {
-        "hash_grouping": v("hash_grouping_enabled"),
-        "adaptive_partial_agg": v("adaptive_partial_aggregation_enabled"),
-        "adaptive_partial_ratio": v(
+        "hash_grouping": SP.prop_value(props, "hash_grouping_enabled"),
+        "adaptive_partial_agg": SP.prop_value(
+            props, "adaptive_partial_aggregation_enabled"),
+        "adaptive_partial_ratio": SP.prop_value(
+            props,
             "adaptive_partial_aggregation_unique_rows_ratio_threshold"),
-        "adaptive_partial_min_rows": v(
-            "adaptive_partial_aggregation_min_rows"),
+        "adaptive_partial_min_rows": SP.prop_value(
+            props, "adaptive_partial_aggregation_min_rows"),
     }
 
 

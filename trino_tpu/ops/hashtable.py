@@ -186,7 +186,8 @@ def hash_segment_reduce(gid, group_rows, ngroups, key_raws: Tuple,
     from .pallas_kernels import segment_reduce
 
     cap = gid.shape[0]
-    if pallas and state_cols:
+    # state_cols is a tuple: pytree arity is trace-static, not traced
+    if pallas and state_cols:  # qlint: ignore[recompile]
         ops = [gid] + list(state_cols)
         sorted_ = jax.lax.sort(ops, num_keys=1, is_stable=False)
         r_gid, r_states = sorted_[0], sorted_[1:]

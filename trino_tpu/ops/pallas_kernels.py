@@ -51,7 +51,8 @@ _WIN = _CHUNK + _LANE  # aligned window covering any chunk's segments
 
 def pallas_mode() -> str:
     """'tpu' (compiled), 'interpret' (forced, CPU), or '' (disabled)."""
-    forced = os.environ.get("TRINO_TPU_PALLAS", "")
+    # trace-static mode switch: read once per compile, by design
+    forced = os.environ.get("TRINO_TPU_PALLAS", "")  # qlint: ignore[trace-purity]
     if forced in ("0", "off"):
         return ""
     try:

@@ -429,9 +429,10 @@ class DeviceExchange:
             if int(np.asarray(overflow).sum()) == 0:
                 break
             if per_dest >= cap:
-                raise RuntimeError(
+                raise T.TrinoError(
                     f"device exchange overflow with per_dest={per_dest} "
-                    f">= sender capacity {cap} (bug, not skew)")
+                    f">= sender capacity {cap} (bug, not skew)",
+                    "GENERIC_INTERNAL_ERROR")
             # backstop only: exact sizing cannot overflow; a stale
             # history presize can, and the doubling recovers it (the
             # observation below re-teaches the history)

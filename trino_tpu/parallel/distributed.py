@@ -405,7 +405,8 @@ class DistributedQueryRunner:
                 else:
                     yield  # quantum boundary: hand the thread back
             else:
-                raise RuntimeError("driver did not finish")
+                raise T.TrinoError("driver did not finish",
+                                   "GENERIC_INTERNAL_ERROR")
             if collect:
                 d.collect_operator_metrics()
                 task.operators.extend(d.stats)

@@ -288,8 +288,11 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
         per_dest *= 2
         retries += 1
         if per_dest > max_per_dest:
-            raise RuntimeError(
-                f"exchange overflow persists at per_dest={per_dest}")
+            from ..types import TrinoError
+
+            raise TrinoError(
+                f"exchange overflow persists at per_dest={per_dest}",
+                "GENERIC_INTERNAL_ERROR")
 
     if stats_out is not None:
         mean_rows = float(part_rows.mean()) if n else 0.0

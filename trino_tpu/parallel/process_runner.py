@@ -92,7 +92,8 @@ from .cluster_memory import ClusterMemoryManager
 from .fault import (EXTERNAL, INSUFFICIENT_RESOURCES, INTERNAL, USER,
                     BackoffPolicy, Deadline, DecayingFailureStats,
                     FaultSchedule, RecoveryStats, RemoteTaskError,
-                    classify_error_code)
+                    classify_error_code, classify_exception,
+                    serialize_failure)
 from .rpc import call, fetch_pages, recv_msg, send_msg, with_trace
 
 
@@ -185,7 +186,9 @@ class _CoordinatorService:
                 except Exception as e:
                     traceback.print_exc()
                     try:
-                        send_msg(self.request, {"error": repr(e)})
+                        # full taxonomy payload, not a bare repr: the
+                        # caller's retry dispatch needs the error type
+                        send_msg(self.request, serialize_failure(e))
                     except OSError:
                         pass
 
@@ -497,7 +500,12 @@ class ProcessQueryRunner:
         try:
             new = self._spawn_worker_process(old.generation + 1)
             self._sync_worker_replicas(new)
-        except Exception:
+        except Exception as e:
+            # swallow deliberately (the next heal tick retries) but
+            # keep the taxonomy in the log: a USER-typed failure here
+            # is a programming error, not churn
+            print(f"[heal] worker replacement failed "
+                  f"({classify_exception(e)}): {e!r}", file=sys.stderr)
             traceback.print_exc()
             if new is not None:   # half-registered replacement: reap it
                 try:
@@ -536,7 +544,12 @@ class ProcessQueryRunner:
             try:
                 self.heal(reason="heartbeat")
                 self.run_memory_governance()
-            except Exception:
+            except Exception as e:
+                # the monitor must survive any tick failure; classify
+                # so the log distinguishes infra churn from bugs
+                print(f"[monitor] heartbeat tick failed "
+                      f"({classify_exception(e)}): {e!r}",
+                      file=sys.stderr)
                 traceback.print_exc()
 
     def run_memory_governance(self) -> Optional[str]:
@@ -750,7 +763,7 @@ class ProcessQueryRunner:
         if isinstance(stmt, (ast.Delete, ast.CreateTable, ast.DropTable)):
             try:
                 catalog, schema, table = self._write_target(stmt)
-            except Exception:
+            except TrinoError:
                 return  # e.g. IF EXISTS on a missing table
             if catalog in self._replicated:
                 # DELETE rewrites pages in place: replicas must replace
@@ -1329,7 +1342,7 @@ class ProcessQueryRunner:
             except TrinoError as e:   # deadline expired mid-attempt
                 fatal.append(e)
             except BaseException as e:
-                errors[t] = (repr(e), INTERNAL)
+                errors[t] = (repr(e), classify_exception(e))
             finally:
                 done[t].set()
 
@@ -1377,7 +1390,7 @@ class ProcessQueryRunner:
             attempt_id = f"{qid}.f{frag.fragment_id}.t{t}.spec"
             try:
                 status, _resp = attempt(t, attempt_id, worker)
-            except BaseException:
+            except BaseException:  # qlint: ignore[taxonomy]
                 return  # a failed speculation never hurts the original
             if status == "win":
                 ctx.recovery.incr("speculative_wins")

@@ -140,7 +140,10 @@ class RemoteExchangeChannel:
                 fired = self._bump_locked()
             for cb in fired:
                 cb()
-        except BaseException as e:
+        except BaseException as e:  # qlint: ignore[taxonomy]
+            # not a swallow: the error parks on the channel (with its
+            # original type intact) and re-raises in the consumer's
+            # pages() pull
             with self._lock:
                 self._error = e
                 self._ended = True
@@ -226,8 +229,11 @@ class RemotePageSink:
             "schema": self.schema, "table": self.table,
             "task": self.task_id, "frames": self._frames})
         if not resp.get("ok"):
-            raise RuntimeError(f"coordinator sink rejected pages: "
-                               f"{resp.get('error')}")
+            from .fault import INTERNAL, RemoteTaskError
+
+            raise RemoteTaskError(f"coordinator sink rejected pages: "
+                                  f"{resp.get('error')}", INTERNAL,
+                                  "PAGE_TRANSPORT_ERROR")
         self._frames = []
 
     def finish(self) -> dict:
@@ -250,10 +256,12 @@ def run_driver_blocking(driver, abort: threading.Event,
     """Drive one pipeline to completion in a dedicated thread, parking
     on listen tokens after no-progress quanta (the process-world twin of
     DistributedQueryRunner._task_gen's streaming loop)."""
+    from .fault import INTERNAL, RemoteTaskError
+
     idle_since = None
     while True:
         if abort.is_set():
-            raise RuntimeError("task aborted")
+            raise RemoteTaskError("task aborted", INTERNAL)
         if driver.process():
             return
         if driver.last_moved:
@@ -270,6 +278,7 @@ def run_driver_blocking(driver, abort: threading.Event,
             if idle_since is None:
                 idle_since = now
             elif now - idle_since > max_idle_s:
-                raise RuntimeError("driver made no progress for "
-                                   f"{max_idle_s}s (stuck pipeline?)")
+                raise RemoteTaskError("driver made no progress for "
+                                      f"{max_idle_s}s (stuck "
+                                      f"pipeline?)", INTERNAL)
             time.sleep(0.002)

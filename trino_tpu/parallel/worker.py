@@ -108,9 +108,13 @@ class WorkerServer:
                 try:
                     outer.dispatch(self.request, req)
                 except Exception as e:  # report, never kill the server
+                    from .fault import serialize_failure
+
                     traceback.print_exc()
                     try:
-                        send_msg(self.request, {"error": repr(e)})
+                        # full taxonomy payload, not a bare repr: the
+                        # coordinator's retry dispatch keys off the type
+                        send_msg(self.request, serialize_failure(e))
                     except OSError:
                         pass
 
@@ -428,7 +432,10 @@ class WorkerServer:
         if not kind:
             return
         if kind == "error":
-            raise RuntimeError(f"injected failure for task {task_id}")
+            # chaos harness: an injected crash must present as an
+            # UNtyped generic failure — that is the class under test
+            raise RuntimeError(  # qlint: ignore[taxonomy]
+                f"injected failure for task {task_id}")
         if kind == "user-error":
             from ..types import TrinoError
 
@@ -518,9 +525,12 @@ class WorkerServer:
                         "schema": node.schema, "table": node.table_name,
                         "columns": node.columns})
                     if not resp.get("ok"):
-                        raise RuntimeError(
+                        from .fault import INTERNAL, RemoteTaskError
+
+                        raise RemoteTaskError(
                             f"coordinator create_table failed: "
-                            f"{resp.get('error')}")
+                            f"{resp.get('error')}", INTERNAL,
+                            "REMOTE_TASK_ERROR")
                 return RemotePageSink(tuple(coordinator), node.catalog,
                                       node.schema, node.table_name,
                                       task_id=req["task_id"])
@@ -682,8 +692,11 @@ class WorkerServer:
             if state.abort.is_set():
                 # a sibling attempt already won (speculative execution):
                 # publishing now would race the query teardown
-                raise RuntimeError(f"task {req['task_id']} aborted "
-                                   "before spool publish")
+                from .fault import INTERNAL, RemoteTaskError
+
+                raise RemoteTaskError(
+                    f"task {req['task_id']} aborted before spool "
+                    f"publish", INTERNAL, "GENERIC_INTERNAL_ERROR")
             nparts = 1 if frag.output_kind in ("single", "broadcast",
                                                "merge") \
                 else req["n_partitions"]
@@ -709,7 +722,8 @@ class WorkerServer:
         torn files (truncate-spool)."""
         kind = fault.get("kind")
         if kind == "fail-after-publish":
-            raise RuntimeError(
+            # chaos harness: deliberately untyped, like a real crash
+            raise RuntimeError(  # qlint: ignore[taxonomy]
                 f"injected failure after spool publish for task "
                 f"{req['task_id']}")
         if kind == "truncate-spool":

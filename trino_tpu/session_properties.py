@@ -3,7 +3,52 @@
 Reference analog: ``SystemSessionProperties.java`` (122 properties,
 1,574 LoC) + airlift config binding. Typed defaults with validation;
 ``SET SESSION`` updates a Session's overrides, engine components read
-through ``value()``.
+through ``value()`` (session objects) / ``prop_value()`` (the bare
+dicts that ride worker RPCs).
+
+Every declared property must have a read site and every literal
+lookup must be declared — machine-checked by the ``session-props``
+pass of ``python -m trino_tpu.analysis`` (a knob that validates but
+changes nothing, like the removed ``page_rows``, is a finding).
+Readers, per property:
+
+========================================== ===========================
+property                                   read by
+========================================== ===========================
+task_concurrency                           parallel/distributed.py
+desired_splits                             runner.py, parallel/worker.py,
+                                           parallel/process_runner.py
+broadcast_join_threshold                   parallel/distributed.py,
+                                           parallel/process_runner.py
+join_distribution_type                     parallel/distributed.py
+query_max_memory_bytes                     runner.py, exec/memory.py,
+                                           parallel/worker.py,
+                                           parallel/process_runner.py
+spill_enabled, spill_to_disk_enabled,      exec/memory.py,
+spill_host_memory_bytes                    parallel/worker.py
+node_max_memory_bytes                      parallel/worker.py
+query_max_total_memory,                    parallel/process_runner.py
+memory_killer_policy, retry_initial_memory
+scan_coalesce_enabled,                     runner.py,
+enable_dynamic_filtering,                  parallel/distributed.py,
+join_max_expand_lanes                      parallel/worker.py
+filter_pushdown_enabled                    planner/rules.py,
+                                           planner/optimizer.py
+streaming_execution,                       parallel/distributed.py,
+exchange_max_pending_pages                 parallel/process_runner.py
+retry_policy, query_max_run_time,          parallel/process_runner.py
+retry_max_attempts, retry_*_backoff,
+speculation_*, query_tracing_enabled
+rpc_request_timeout                        parallel/process_runner.py,
+                                           parallel/worker.py
+hash_grouping_enabled,                     exec/local_planner.py
+adaptive_partial_aggregation_*             (grouping_options)
+device_exchange, device_exchange_sizing,   parallel/distributed.py
+hot_partition_split_threshold,
+scale_writers_enabled
+rebalance_min_collectives                  parallel/distributed.py,
+                                           parallel/worker.py
+========================================== ===========================
 """
 
 from __future__ import annotations
@@ -49,10 +94,6 @@ register(SessionProperty(
     "AUTOMATIC | BROADCAST | PARTITIONED",
     lambda v: v in ("AUTOMATIC", "BROADCAST", "PARTITIONED"),
     normalize=str.upper))
-register(SessionProperty(
-    "page_rows", "integer", 65536,
-    "Rows per scan page (device batch size)",
-    lambda v: v >= 64))
 register(SessionProperty(
     "query_max_memory_bytes", "integer", 8 << 30,
     "Per-query device-memory accounting limit",
@@ -134,7 +175,7 @@ register(SessionProperty(
     "before the producing pipeline stalls",
     lambda v: v >= 1))
 register(SessionProperty(
-    "retry_policy", "string", "QUERY",
+    "retry_policy", "varchar", "QUERY",
     "Failure recovery for the multi-process runtime: NONE (fail), "
     "QUERY (re-run the query), TASK (durable spooled exchange; failed "
     "tasks retry from spool WITHOUT re-running producer stages)",
