@@ -406,6 +406,8 @@ def _trace_smoke() -> dict:
            "join orders o on c.c_custkey = o.o_custkey "
            "where c.c_mktsegment = 'BUILDING' "
            "order by o.o_orderkey limit 10")
+    from trino_tpu.resources.tpch_queries import TPCH_QUERIES
+
     t0 = time.time()
     with ProcessQueryRunner(
             {"tpch": {"connector": "tpch", "page_rows": 4096}},
@@ -413,6 +415,13 @@ def _trace_smoke() -> dict:
             n_workers=2, desired_splits=4,
             broadcast_threshold=300.0) as c:
         res = c.execute(sql)
+        # q3 multi-stage wall-clock (scan -> join -> agg -> TopN over
+        # 4 fragments): the number streaming pipelining moves — the
+        # first run warms compile caches, the second is the measurement
+        c.execute(TPCH_QUERIES[3])
+        t_q3 = time.time()
+        c.execute(TPCH_QUERIES[3])
+        q3_wall = round(time.time() - t_q3, 3)
     spans = (res.stats or {}).get("trace") or []
     roots, _children, orphans = span_tree(spans)
     artifact = os.environ.get("BENCH_TRACE_PATH",
@@ -422,20 +431,38 @@ def _trace_smoke() -> dict:
     overlap = stage_overlap(spans)
     workers = {s["process"] for s in spans
                if s["process"].startswith("worker")}
+    # the RATCHET (round 9): stage_overlap is regression-guarded like
+    # the rows/s rates — a change that re-introduces a stage barrier
+    # (overlap collapsing toward 0) fails the check loudly instead of
+    # sliding by as a perf note
+    base = _load_cache().get("trace_stage_overlap")
+    ratio = round(overlap / base, 3) if base else 0.0
+    floor = float(os.environ.get("BENCH_TRACE_RATCHET_MIN", "0.8"))
+    regressed = bool(base) and ratio < floor
     out = {
         "ok": bool(spans) and len(roots) == 1 and not orphans
-        and len(workers) >= 2,
+        and len(workers) >= 2 and not regressed,
         "spans": len(spans), "orphans": len(orphans),
         "worker_lanes": len(workers),
         "stage_overlap": round(overlap, 4),
         "artifact": artifact,
+        "q3_wall_s": q3_wall,
         "wall_s": round(time.time() - t0, 2),
     }
     print(json.dumps({
+        "metric": "trace_q3_wall_s", "value": q3_wall, "unit": "s",
+        "vs_baseline": 0.0,
+    }), flush=True)
+    print(json.dumps({
         "metric": "trace_stage_overlap", "value": out["stage_overlap"],
-        "unit": "fraction", "vs_baseline": 0.0,
+        "unit": "fraction", "vs_baseline": ratio,
         "spans": out["spans"], "artifact": artifact,
     }), flush=True)
+    if regressed:
+        print(json.dumps({
+            "metric": "trace_stage_overlap_regressed", "value": ratio,
+            "unit": "x_vs_baseline", "vs_baseline": ratio,
+        }), flush=True)
     print("TRACE_RESULT " + json.dumps(out), flush=True)
     if not out["ok"]:
         raise SystemExit(7)
@@ -655,7 +682,12 @@ def main():
             time.sleep(0.5)
         trace_text = tracer.kill()
         for line in trace_text.splitlines():
-            if line.startswith('{"metric": "trace_stage_overlap"'):
+            if line.startswith('{"metric": "trace_stage_overlap_'
+                               'regressed"'):
+                # the overlap ratchet tripped: fail the whole bench run
+                # like a rows/s regression does
+                state.setdefault("regressed", []).append(line)
+            elif line.startswith('{"metric": "trace_'):
                 print(line, flush=True)
         sys.stderr.write(f"bench: trace child tail:\n"
                          f"{trace_text[-600:]}\n")

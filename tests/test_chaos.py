@@ -369,19 +369,42 @@ def test_kill_worker_streaming_query_retry(local, stream_cluster):
     _await_capacity(c)
 
 
-def test_rpc_drop_streaming_query_retry(stream_cluster):
-    """A mid-frame drop on the streaming pull: the drain cursor already
-    advanced server-side, so in-place reconnect would silently lose
-    pages — the channel classifies it connection-lost and the query
-    retries."""
+def test_rpc_drop_streaming_replays_in_place(stream_cluster):
+    """A mid-frame drop on the streaming pull RECOVERS IN PLACE: the
+    producer retains unacked frames (_RetainedStream), so the channel
+    reconnects and replays them byte-identically from its cursor — zero
+    full-query restarts for a single dropped connection, identical
+    attempt shape to the fault-free run."""
     c = stream_cluster
     _await_capacity(c)
+    mark0 = len(c.task_launches)
     clean = sorted(c.execute(Q1).rows)
+    clean_count = len(c.task_launches) - mark0
     qid = _next_qid(c)
     c.fault_schedule.add(f"{qid}.f0", "drop-connection")
+    mark = len(c.task_launches)
     res = c.execute(Q1)
     assert sorted(res.rows) == clean
-    assert res.stats["recovery"]["query_retries"] >= 1
+    assert res.stats["recovery"]["query_retries"] == 0
+    launches = _launches_since(c, mark)
+    assert len(launches) == clean_count, (launches, clean_count)
+    assert not any("a1." in t for t in launches), launches
+
+
+def test_rpc_drop_streaming_repeated_drops_still_replay(stream_cluster):
+    """Several torn connections across the query's streaming pulls
+    (one drop per producer task, on both fragments) all replay in
+    place — drops on independent streams never accumulate toward any
+    shared budget or escalate to a query retry."""
+    c = stream_cluster
+    _await_capacity(c)
+    clean = sorted(c.execute(Q3).rows)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f0", "drop-connection", times=2)
+    c.fault_schedule.add(f"{qid}.f1", "drop-connection")
+    res = c.execute(Q3)
+    assert sorted(res.rows) == clean
+    assert res.stats["recovery"]["query_retries"] == 0
 
 
 def test_user_error_fails_fast_streaming(stream_cluster):

@@ -132,6 +132,44 @@ def test_spill_frame_roundtrip(tmp_path):
     assert np.array_equal(c3[0], cols[0]) and np.array_equal(v3, valid)
 
 
+def test_spill_file_streaming_read_write(tmp_path):
+    """The streaming spill paths (chunked compressobj write + bounded
+    incremental read) interoperate both ways with the one-shot frame
+    forms, and a corrupted/torn FILE fails loudly on the streaming
+    read — CRC verifies before any array is handed back."""
+    from trino_tpu.exec.serde import _SPILL_CHUNK
+
+    cols = [np.arange(_SPILL_CHUNK // 4 + 7, dtype=np.int64)]  # > chunk
+    nulls = [np.zeros(len(cols[0]), dtype=bool)]
+    valid = np.arange(len(cols[0])) < 50
+    path = str(tmp_path / "big.bin")
+    write_spill_file(path, cols, nulls, valid)
+    # streaming write -> one-shot parse (format unchanged on disk)
+    c1, n1, v1 = parse_spill_frame(open(path, "rb").read())
+    assert np.array_equal(c1[0], cols[0])
+    # streaming read
+    c2, n2, v2 = read_spill_file(path)
+    assert np.array_equal(c2[0], cols[0])
+    assert np.array_equal(v2, valid)
+    assert c2[0].flags.writeable
+    # one-shot write -> streaming read
+    with open(path, "wb") as f:
+        f.write(spill_frame(cols, nulls, valid))
+    c3, _, _ = read_spill_file(path)
+    assert np.array_equal(c3[0], cols[0])
+    # corruption: flipped body byte, then a torn tail
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(blob)
+    with pytest.raises(TrinoError):
+        read_spill_file(path)
+    with open(path, "wb") as f:
+        f.write(spill_frame(cols, nulls, valid)[: len(blob) // 2])
+    with pytest.raises(TrinoError):
+        read_spill_file(path)
+
+
 def test_spill_frame_detects_corruption(tmp_path):
     cols, nulls, valid = _arrays()
     frame = bytearray(spill_frame(cols, nulls, valid))

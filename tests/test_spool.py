@@ -37,6 +37,33 @@ def test_spool_roundtrip(tmp_path):
         read_spool(d, 0)
 
 
+def test_spool_cursor_start_page_resumes_mid_stream(tmp_path):
+    """The page-range cursor seam for partial-stage retry: a consumer
+    resuming with start_page=N re-decodes but does NOT re-yield the
+    first N pages (serde dictionary deltas are positional), so the
+    replayed stream is exactly the unconsumed tail."""
+    from trino_tpu.parallel.spool import spool_task_cursor
+
+    mgr = FileSystemExchangeManager(str(tmp_path))
+    sink = mgr.create_sink("q3", 0, task=0, n_partitions=1)
+    pages = [Page.from_pylists([T.BIGINT, T.VARCHAR],
+                               [[i, i + 10], [f"s{i}", f"s{i + 10}"]])
+             for i in range(3)]
+    for p in pages:
+        sink.add(0, p)
+    sink.finish()
+    d = mgr.exchange_dir("q3", 0)
+    cur = spool_task_cursor(d, 0, 0, start_page=2)
+    got = []
+    while True:
+        p = cur.poll()
+        if p is None and cur.at_end():
+            break
+        got.extend(p.to_rows())
+    cur.close()
+    assert got == pages[2].to_rows()
+
+
 def test_unfinished_sink_not_visible(tmp_path):
     """A sink that never finished (producer died) must leave nothing
     readable — write-then-rename atomicity."""

@@ -464,6 +464,95 @@ def test_taxonomy_scoped_to_parallel_and_pragma():
     assert run_passes(idx, ["taxonomy"]) == []
 
 
+# -- blocked-protocol ----------------------------------------------------
+
+def test_blocked_protocol_partial_channel_and_stale_token():
+    idx = index_of(**{"pkg.chan": """
+        class HalfChannel:
+            def poll(self):
+                return self._q.pop(0) if self._q else None
+
+            def listen(self):
+                return self._token
+
+        class Source:
+            def blocked_token(self):
+                return self._chan.listen()   # no readiness re-check
+    """})
+    found = run_passes(idx, ["blocked-protocol"])
+    got = rules(found)
+    assert ("blocked-protocol", "channel-contract") in got
+    assert ("blocked-protocol", "stale-token-park") in got
+    contract = [f for f in found if f.rule == "channel-contract"]
+    assert "at_end" in contract[0].message
+    assert "has_page" in contract[0].message
+
+
+def test_blocked_protocol_waker_under_lock():
+    idx = index_of(**{"pkg.buf": """
+        import threading
+
+        class Buf:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._listeners = []
+
+            def enqueue(self, page):
+                with self._lock:
+                    self._pages.append(page)
+                    for cb in self._listeners:
+                        cb()      # fires under the state lock
+    """})
+    found = run_passes(idx, ["blocked-protocol"])
+    assert ("blocked-protocol", "waker-under-lock") in rules(found)
+
+
+def test_blocked_protocol_repo_idioms_are_clean():
+    """The engine's own patterns pass: full quartet, snapshot-then-
+    recheck blocked_token, collect-under-lock / fire-after-release."""
+    idx = index_of(**{"pkg.ok": """
+        import threading
+
+        class Chan:
+            def poll(self):
+                return None
+
+            def at_end(self):
+                return True
+
+            def has_page(self):
+                return False
+
+            def listen(self):
+                return self._token
+
+        class Source:
+            def blocked_token(self):
+                token = self._chan.listen()
+                if self._chan.at_end() or self._chan.has_page():
+                    return None
+                return token
+
+        class Buf:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._listeners = []
+
+            def _bump_locked(self):
+                fired = list(self._listeners)
+                self._listeners.clear()
+                return fired
+
+            def enqueue(self, page):
+                with self._lock:
+                    self._pages.append(page)
+                    fired = self._bump_locked()
+                for cb in fired:
+                    cb()
+    """})
+    assert run_passes(idx, ["blocked-protocol"]) == []
+
+
 # -- framework plumbing --------------------------------------------------
 
 def test_unknown_pass_rejected():
@@ -546,6 +635,12 @@ def test_gate_passes_are_not_blind_on_the_real_repo(repo_findings):
     assert len(declared) >= 30
     assert declared["retry_policy"][0] == "varchar"
     assert "page_rows" not in declared
+    from trino_tpu.analysis.blocked_protocol import channel_classes
+    chans = channel_classes(index)
+    assert len(chans) >= 5, chans
+    assert "trino_tpu.parallel.remote_exchange:RemoteExchangeChannel" \
+        in chans
+    assert "trino_tpu.parallel.spool:SpoolCursor" in chans
 
 
 def test_cli_runs_clean_and_json(tmp_path):

@@ -174,3 +174,189 @@ def test_abort_unblocks_producers_and_consumers():
     assert not buf.full()
     chan = ExchangeChannel(buf, 0, 0)
     assert chan.poll() is None and chan.at_end()
+
+
+# ---------------------------------------------------------------------------
+# Pipelined-overlap suite (round 9): the ack-based streaming cursor
+# protocol — first-page latency, reconnect replay byte-equality, and
+# the merge exchange preserving order end-to-end.
+# ---------------------------------------------------------------------------
+
+
+def _stream_server():
+    """A real WorkerServer (in-process, no subprocess spawn) with one
+    manually-registered streaming task state: the smallest harness that
+    exercises the REAL get_page_stream cursor protocol + retained-frame
+    replay against the REAL RemoteExchangeChannel."""
+    from trino_tpu.parallel.worker import WorkerServer, _TaskState
+
+    server = WorkerServer(0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    state = _TaskState()
+    state.buffer = OutputBuffer(1, max_pending_pages=64)
+    server.tasks["t0"] = state
+    return server, state, ("127.0.0.1", server.port)
+
+
+def _pages(n, rows_per=8):
+    from trino_tpu.block import Page
+    from trino_tpu import types as T
+
+    out = []
+    for i in range(n):
+        base = i * rows_per
+        out.append(Page.from_pylists(
+            [T.BIGINT, T.VARCHAR],
+            [[base + j for j in range(rows_per)],
+             [f"v{base + j}" for j in range(rows_per)]]))
+    return out
+
+
+def _drain(chan, deadline_s=30):
+    rows = []
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        p = chan.poll()
+        if p is not None:
+            rows.extend(p.to_rows())
+        elif chan.at_end():
+            return rows
+        else:
+            time.sleep(0.01)
+    raise AssertionError("stream never ended")
+
+
+def test_first_page_latency_consumer_receives_page_0_while_running():
+    """The pipelining witness at the protocol level: the consumer holds
+    page 0 while the producing task is still running (long before EOS),
+    and the channel's first_page_ms stat records the latency."""
+    from trino_tpu.parallel.remote_exchange import RemoteExchangeChannel
+
+    server, state, addr = _stream_server()
+    pages = _pages(3)
+    try:
+        state.buffer.enqueue(0, pages[0])
+        chan = RemoteExchangeChannel([(addr, "t0")], 0, poll_wait=0.1)
+        try:
+            deadline = time.time() + 20
+            got = None
+            while got is None and time.time() < deadline:
+                got = chan.poll()
+                time.sleep(0.005)
+            # page 0 arrived while the producer is STILL RUNNING
+            assert got is not None
+            assert state.status == "running"
+            assert not state.buffer._no_more
+            assert got.to_rows() == pages[0].to_rows()
+            for p in pages[1:]:
+                state.buffer.enqueue(0, p)
+            state.status = "finished"
+            state.buffer.set_no_more_pages()
+            rest = _drain(chan)
+            assert rest == [r for p in pages[1:] for r in p.to_rows()]
+            stats = chan.stats
+            assert stats["first_page_ms"] is not None
+            assert stats["pages"] == 3
+        finally:
+            chan.close()
+    finally:
+        server.server.shutdown()
+
+
+def test_ack_replay_reconnect_byte_equality():
+    """Torn connections mid-frame on the streaming pull: the producer
+    retains unacked frames, the channel reconnects and replays them —
+    the reassembled stream equals the enqueued pages exactly (incl.
+    dictionary-pool deltas), with the reconnect/replay counters up and
+    acked frames released server-side."""
+    from trino_tpu.parallel.remote_exchange import RemoteExchangeChannel
+
+    server, state, addr = _stream_server()
+    pages = _pages(6)
+    want = [r for p in pages for r in p.to_rows()]
+    try:
+        for p in pages[:2]:
+            state.buffer.enqueue(0, p)
+        state.drop_results = 2   # tear the next two replies mid-frame
+        chan = RemoteExchangeChannel([(addr, "t0")], 0, poll_wait=0.1)
+        try:
+            got = []
+            deadline = time.time() + 30
+            while len(got) < 2 * 8 and time.time() < deadline:
+                p = chan.poll()
+                if p is not None:
+                    got.extend(p.to_rows())
+                time.sleep(0.005)
+            for p in pages[2:]:
+                state.buffer.enqueue(0, p)
+            state.status = "finished"
+            state.buffer.set_no_more_pages()
+            got.extend(_drain(chan))
+            assert got == want
+            assert chan.reconnects >= 1
+            assert chan.replayed_frames >= 1
+            # the consumer's acks released retained frames: the stream
+            # cursor advanced past the replayed range
+            rs = state.streams[(0, 0)]
+            assert rs.base >= 2
+        finally:
+            chan.close()
+    finally:
+        server.server.shutdown()
+
+
+def test_unreachable_peer_exhausts_reconnect_budget():
+    """A peer that STAYS unreachable (nothing listening) escalates to
+    ExchangeConnectionLost after the reconnect budget, instead of
+    retrying forever — the query-retry path still exists for real
+    worker death."""
+    import socket
+
+    from trino_tpu.parallel.remote_exchange import (
+        ExchangeConnectionLost, RemoteExchangeChannel)
+
+    # grab a port with no listener
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    chan = RemoteExchangeChannel([(("127.0.0.1", port), "t0")], 0,
+                                 rpc_timeout=1.0)
+    try:
+        deadline = time.time() + 30
+        with pytest.raises(ExchangeConnectionLost):
+            while time.time() < deadline:
+                chan.poll()
+                if chan.at_end():
+                    break
+                time.sleep(0.02)
+        assert chan.reconnects > RemoteExchangeChannel.RECONNECT_ATTEMPTS
+    finally:
+        chan.close()
+
+
+def test_order_by_merge_streams_exact_order(local):
+    """Distributed ORDER BY runs as sort-per-task + k-way streaming
+    merge (no gather-then-resort): row ORDER equals the local oracle
+    exactly, streaming and barrier modes agree."""
+    sql = ("select o_orderkey, o_totalprice from orders "
+           "order by o_orderkey")
+    want = local.execute(sql).rows
+    got_stream = make_dist(True).execute(sql).rows
+    got_barrier = make_dist(False).execute(sql).rows
+    assert got_stream == want      # exact order, not set equality
+    assert got_barrier == want
+
+
+def test_order_by_merge_overlaps_producer(local):
+    """The merge boundary itself streams: the consumer's k-way merge
+    dequeues sorted-run pages while producer tasks are still running
+    (the fragment's buffer overlap witness)."""
+    sql = ("select l_orderkey, l_extendedprice from lineitem "
+           "order by l_orderkey, l_linenumber")
+    want = local.execute(sql).rows
+    r = make_dist(True)
+    res = r.execute(sql)
+    assert res.rows == want
+    overlap = res.stats["streaming_overlap"]
+    assert any(overlap.values()), f"no stage overlap: {overlap}"

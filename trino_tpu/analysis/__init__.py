@@ -5,7 +5,7 @@ The engine carries several correctness invariants that exist only as
 prose in docstrings and PR descriptions; each was a hand-found bug
 once.  This package machine-checks them with stdlib ``ast`` (no JAX
 import, no new deps) over a shared module-index/call-graph core
-(``core.py``) and five passes:
+(``core.py``) and six passes:
 
 - ``trace-purity`` — no host side-effects (spans, metrics, locks,
   ``time.*``, IO, ``print``) reachable inside jit'd/shard_map'd/Pallas
@@ -22,7 +22,11 @@ import, no new deps) over a shared module-index/call-graph core
   come from the registry vocabulary;
 - ``taxonomy`` — in ``parallel/``, no bare ``raise RuntimeError`` /
   ``raise Exception`` and no broad ``except Exception`` handlers that
-  swallow without routing through ``parallel/fault.py``.
+  swallow without routing through ``parallel/fault.py``;
+- ``blocked-protocol`` — the streaming driver's Blocked/listen-token
+  contract: channels implement the full poll/at_end/has_page/listen
+  quartet, ``blocked_token`` re-checks readiness after its ``listen()``
+  snapshot, waker callbacks never fire under a held lock.
 
 Checked-in suppressions live in ``analysis_baseline.json`` at the repo
 root (pre-existing, triaged findings only — the file may only shrink);
@@ -71,6 +75,11 @@ def _pass_taxonomy(index):
     return run(index)
 
 
+def _pass_blocked_protocol(index):
+    from .blocked_protocol import run
+    return run(index)
+
+
 #: pass slug -> runner(index) -> List[Finding]; slugs are the names
 #: used by --passes, pragmas and baseline keys
 PASSES = {
@@ -79,6 +88,7 @@ PASSES = {
     "recompile": _pass_recompile,
     "session-props": _pass_session_props,
     "taxonomy": _pass_taxonomy,
+    "blocked-protocol": _pass_blocked_protocol,
 }
 
 

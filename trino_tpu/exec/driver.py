@@ -51,12 +51,13 @@ class OperatorStats:
             extras = " ".join(
                 f"{k}={m[k]}" for k in ("skew_ratio", "lane_skew_ratio",
                                         "per_dest", "a2a_retries",
-                                        "sizing")
+                                        "sizing", "first_page_ms")
                 if m.get(k) is not None)
-            # split/rebalance counters only when the mechanism engaged
-            # (a zero on every boundary would be noise)
+            # split/rebalance/replay counters only when the mechanism
+            # engaged (a zero on every boundary would be noise)
             extras += "".join(
-                f" {k}={m[k]}" for k in ("splits", "rebalances")
+                f" {k}={m[k]}" for k in ("splits", "rebalances",
+                                         "reconnects", "replayed_frames")
                 if m.get(k))
             if extras:
                 base += f" [exchange {extras}]"

@@ -231,6 +231,33 @@ class MergeExchangeSourceOperator(SourceOperator):
         return DevicePage(list(merged.types), s_cols, s_nulls,
                           emit_valid, list(merged.dictionaries))
 
+    def metrics(self) -> Optional[dict]:
+        """Aggregated per-stream channel stats (the merge consumes one
+        channel per producer): flow counters plus the ack/replay
+        machinery's reconnect counters when it engaged — the same
+        surface ExchangeSourceOperator exposes for single channels."""
+        out = {"kind": "merge-stream", "streams": len(self.streams)}
+        rows = pages = reconnects = replayed = 0
+        seen = False
+        for s in self.streams:
+            st = getattr(s._chan, "stats", None) if s._chan is not None \
+                else None
+            if not st:
+                continue
+            seen = True
+            rows += st.get("rows", 0)
+            pages += st.get("pages", 0)
+            reconnects += st.get("reconnects", 0)
+            replayed += st.get("replayed_frames", 0)
+        if not seen:
+            return None
+        out["rows"] = rows
+        out["pages"] = pages
+        if reconnects:
+            out["reconnects"] = reconnects
+            out["replayed_frames"] = replayed
+        return out
+
     def blocked_token(self):
         if self._done:
             return None

@@ -1457,16 +1457,22 @@ class ProcessQueryRunner:
         def on_retry(exc):
             ctx.recovery.record_retry(EXTERNAL)
 
+        # spool cursors hold an open fd across polls: track them so a
+        # failed execution closes them deterministically instead of
+        # waiting for the plan object's GC
+        spool_cursors: List = []
+
         def exchange_reader(fragment_id: int, kind: str):
             src = locations[fragment_id]
             part = 0  # output stage is task 0 of 1
             if kind == "merge":
                 if src.get("spool_dir"):
-                    from .spool import read_spool_task
+                    from .spool import spool_task_cursor
 
-                    return [(lambda i=i: read_spool_task(
-                        src["spool_dir"], 0, i))
-                        for i in range(len(src["locations"]))]
+                    cursors = [spool_task_cursor(src["spool_dir"], 0, i)
+                               for i in range(len(src["locations"]))]
+                    spool_cursors.extend(cursors)
+                    return cursors
 
                 def task_thunk(loc):
                     def thunk():
@@ -1478,9 +1484,12 @@ class ProcessQueryRunner:
 
                 return [task_thunk(loc) for loc in src["locations"]]
             if src.get("spool_dir"):
-                from .spool import read_spool
+                from .spool import spool_channel
 
-                return lambda: read_spool(src["spool_dir"], part)
+                # frame-per-page cursor stream over the durable output
+                chan = spool_channel(src["spool_dir"], part)
+                spool_cursors.append(chan)
+                return chan
 
             def thunk():
                 pages: List[Page] = []
@@ -1527,6 +1536,9 @@ class ProcessQueryRunner:
             # transport-only: the producing worker or its buffers are
             # gone (FileNotFoundError covers an unpublished spool)
             raise _WorkerLost(f"output stage pull failed: {e}")
+        finally:
+            for cur in spool_cursors:
+                cur.close()
 
     def _release(self, query_tasks):
         """Free worker-side task buffers once results are drained

@@ -28,7 +28,13 @@ class ExchangeConnectionLost(RuntimeError):
     """An upstream worker died or its task buffers vanished: the stream
     cannot be completed. Tagged so the coordinator can classify the
     failure as retry-the-query rather than a user error (reference:
-    RetryPolicy.QUERY on DirectExchange failures)."""
+    RetryPolicy.QUERY on DirectExchange failures).
+
+    NOT raised for a merely-torn connection: the ack-based cursor
+    protocol (worker ``get_page_stream`` + ``_RetainedStream``) lets
+    the channel reconnect and replay the unacked frame range in place,
+    so only a peer that stays unreachable (dead worker) or reports its
+    buffers gone escalates to query retry."""
 
 
 class _ChannelToken:
@@ -51,6 +57,10 @@ class RemoteExchangeChannel:
     remote tasks. A background fetcher round-robins the upstream tasks
     with short long-polls, deserializing into a bounded local queue."""
 
+    #: reconnect budget per torn connection run: a worker that stays
+    #: unreachable this many times in a row is declared lost
+    RECONNECT_ATTEMPTS = 4
+
     def __init__(self, locations: List[Tuple[tuple, str]], partition: int,
                  consumer_id: int = 0, max_local: int = 16,
                  poll_wait: float = 0.5, rpc_timeout: float = 60.0):
@@ -71,22 +81,70 @@ class RemoteExchangeChannel:
                          for addr, task_id in locations]
         self._des: Dict[str, PageDeserializer] = {
             task_id: PageDeserializer() for _, task_id in self._pending}
+        #: per-task frame cursor: complete frames deserialized so far —
+        #: doubles as the ack shipped with every pull, and as the replay
+        #: point after a reconnect
+        self._cursors: Dict[str, int] = {
+            task_id: 0 for _, task_id in self._pending}
+        self._fail_counts: Dict[str, int] = {}
+        #: per-task reconnect-backoff deadline (monotonic): a failing
+        #: peer is SKIPPED in the round-robin until its deadline
+        #: passes, so its backoff never stalls pulls from healthy
+        #: upstream tasks
+        self._retry_at: Dict[str, float] = {}
+        # streaming observability (read via .stats)
+        self.reconnects = 0
+        self.replayed_frames = 0
+        self.pages_received = 0
+        self.rows_received = 0
+        self._created = time.monotonic()
+        self.first_page_ts: Optional[float] = None
         self._thread = threading.Thread(target=self._fetch_loop,
                                         daemon=True)
         self._thread.start()
 
     # -- fetcher ---------------------------------------------------------
 
-    def _fetch_loop(self):
+    def _pull_once(self, addr, task_id: str):
+        """One cursor-addressed pull. The request acks everything the
+        deserializer consumed (the producer may free it) and asks for
+        frames from that same index."""
         from .rpc import recv_frame, recv_msg, send_msg
         import socket
 
+        cursor = self._cursors[task_id]
+        # connect phase capped well below rpc_timeout: one blackholed
+        # peer (SYN dropped, not refused) must not stall the shared
+        # round-robin fetch loop for a full rpc_timeout per attempt —
+        # escalation to ExchangeConnectionLost stays prompt and healthy
+        # upstreams keep flowing. Established sockets get the full
+        # timeout for the long-poll reads.
+        with socket.create_connection(
+                addr, timeout=min(self.rpc_timeout, 5.0)) as sock:
+            sock.settimeout(self.rpc_timeout)
+            send_msg(sock, {
+                "op": "get_page_stream",
+                "task_id": task_id,
+                "partition": self.partition,
+                "consumer_id": self.consumer_id,
+                "wait": self.poll_wait,
+                "cursor": cursor, "ack": cursor})
+            head = recv_msg(sock)
+            frames = [recv_frame(sock)
+                      for _ in range(head.get("n_pages", 0))]
+        return head, frames
+
+    def _fetch_loop(self):
         try:
             while not self._stop and self._pending:
                 progressed = False
+                attempted = False
                 for addr, task_id in list(self._pending):
                     if self._stop:
                         return
+                    if time.monotonic() < self._retry_at.get(
+                            task_id, 0.0):
+                        continue   # backing off; healthy peers first
                     # local backpressure: don't outrun the consumer
                     while not self._stop and self._qsize() >= self.max_local:
                         self._drained.clear()
@@ -94,21 +152,30 @@ class RemoteExchangeChannel:
                             self._drained.wait(0.2)
                     if self._stop:
                         return
+                    attempted = True
                     try:
-                        with socket.create_connection(
-                                addr, timeout=self.rpc_timeout) as sock:
-                            send_msg(sock, {
-                                "op": "get_page_stream",
-                                "task_id": task_id,
-                                "partition": self.partition,
-                                "consumer_id": self.consumer_id,
-                                "wait": self.poll_wait})
-                            head = recv_msg(sock)
-                            frames = [recv_frame(sock)
-                                      for _ in range(head.get("n_pages", 0))]
+                        head, frames = self._pull_once(addr, task_id)
                     except OSError as e:
-                        raise ExchangeConnectionLost(
-                            f"pull from {addr} task {task_id}: {e!r}")
+                        # torn connection (incl. mid-frame): the cursor
+                        # protocol makes the pull idempotent — reconnect
+                        # and replay the unacked range instead of
+                        # failing the query. Only a peer that stays
+                        # unreachable escalates.
+                        fails = self._fail_counts.get(task_id, 0) + 1
+                        self._fail_counts[task_id] = fails
+                        self.reconnects += 1
+                        if fails > self.RECONNECT_ATTEMPTS:
+                            raise ExchangeConnectionLost(
+                                f"pull from {addr} task {task_id} "
+                                f"failed {fails} times: {e!r}")
+                        # deadline, not a sleep: sleeping here would
+                        # stall the shared fetch loop for every other
+                        # (healthy) upstream task
+                        self._retry_at[task_id] = time.monotonic() + \
+                            min(0.05 * (2 ** (fails - 1)), 1.0)
+                        continue
+                    self._fail_counts.pop(task_id, None)
+                    self._retry_at.pop(task_id, None)
                     if head.get("error"):
                         msg = head["error"]
                         if head.get("connection_lost") or \
@@ -122,8 +189,27 @@ class RemoteExchangeChannel:
                         raise RemoteTaskError.from_response(
                             head, f"upstream task {task_id} failed")
                     if frames:
+                        cursor = self._cursors[task_id]
+                        start = int(head.get("start", cursor))
+                        if start > cursor:
+                            raise ExchangeConnectionLost(
+                                f"stream hole from task {task_id}: "
+                                f"have {cursor}, got start={start}")
+                        # drop any prefix the deserializer already
+                        # consumed; the producer also reports how many
+                        # of these frames are re-sends of a torn reply
+                        frames = frames[cursor - start:]
+                        self.replayed_frames += int(
+                            head.get("replayed", 0))
+                    if frames:
                         de = self._des[task_id]
                         pages = [de.deserialize(f) for f in frames]
+                        self._cursors[task_id] += len(frames)
+                        self.pages_received += len(pages)
+                        self.rows_received += sum(p.num_rows
+                                                  for p in pages)
+                        if self.first_page_ts is None:
+                            self.first_page_ts = time.monotonic()
                         with self._lock:
                             self._queue.extend(pages)
                             fired = self._bump_locked()
@@ -135,6 +221,14 @@ class RemoteExchangeChannel:
                         progressed = True
                 if not progressed and not self._pending:
                     break
+                if not attempted and self._pending:
+                    # every pending task is backing off: wait for the
+                    # earliest deadline instead of busy-spinning
+                    now = time.monotonic()
+                    wait = min(self._retry_at.get(t, now) - now
+                               for _, t in self._pending)
+                    if wait > 0:
+                        time.sleep(min(wait, 1.0))
             with self._lock:
                 self._ended = True
                 fired = self._bump_locked()
@@ -191,6 +285,25 @@ class RemoteExchangeChannel:
         self._stop = True
         self._drained.set()
         self._thread.join(timeout=5)
+
+    @property
+    def stats(self) -> dict:
+        """Streaming-pull observability, surfaced through
+        ExchangeSourceOperator.metrics into operator stats/spans: how
+        much flowed, and whether the ack/replay machinery engaged."""
+        out = {"kind": "stream",
+               "rows": self.rows_received,
+               "pages": self.pages_received}
+        if self.first_page_ts is not None:
+            # pipelining witness: how soon after the channel opened the
+            # first upstream page landed (a barrier would pay the whole
+            # producer wall here)
+            out["first_page_ms"] = round(
+                (self.first_page_ts - self._created) * 1e3, 1)
+        if self.reconnects:
+            out["reconnects"] = self.reconnects
+            out["replayed_frames"] = self.replayed_frames
+        return out
 
 
 class RemotePageSink:

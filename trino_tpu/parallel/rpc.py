@@ -80,8 +80,9 @@ def fetch_pages(addr, task_id: str, partition: int,
       ``get_results`` response is a complete, independently-serialized
       snapshot (the worker keeps the buffer and builds a fresh serde
       stream per request), so a re-pull cannot lose or duplicate pages.
-      Streaming pulls (``get_page_stream``) must NOT reconnect — their
-      drain cursor advances server-side — and use their own channel.
+      Streaming pulls (``get_page_stream``) reconnect through their own
+      channel's ack-based cursor (RemoteExchangeChannel): the producer
+      retains unacked frames and replays them byte-identically.
     """
     import time
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import struct
 import tempfile
+import threading
 from typing import List, Optional
 
 from ..exec.serde import PageDeserializer, PageSerializer
@@ -76,30 +77,157 @@ class ExchangeSink:
                 pass
 
 
-def _read_task_file(path: str) -> List:
-    """Decode one task's length-prefixed spool frames — THE one reader
-    of the on-disk framing (shared by the per-partition and per-task
-    sources)."""
-    pages: List = []
-    de = PageDeserializer()  # one serde stream per producing task file
-    with open(path, "rb") as f:
-        while True:
-            head = f.read(4)
-            if not head:
-                break
-            if len(head) < 4:
-                raise SpoolCorruption(f"torn frame header in {path}")
-            (n,) = struct.unpack("<I", head)
-            blob = f.read(n)
-            if len(blob) < n:
-                # a published file must hold complete frames; a short
-                # read means on-disk corruption (e.g. torn by a crashed
-                # host) — losing rows silently is never acceptable
-                raise SpoolCorruption(
-                    f"torn frame in {path}: expected {n} bytes, "
-                    f"read {len(blob)}")
-            pages.append(de.deserialize(blob))
-    return pages
+class _ImmediateToken:
+    """Listen token for file-backed streams: the state is always
+    'changed' (a published spool never blocks), so the callback fires
+    immediately — keeps cursors honest members of the poll/at_end/
+    listen channel contract without inventing fake waits."""
+
+    __slots__ = ()
+
+    def on_ready(self, cb):
+        cb()
+
+
+_IMMEDIATE = _ImmediateToken()
+
+
+class SpoolCursor:
+    """Frame-per-page reader over ONE producing task's published spool
+    file with an explicit page-range cursor — the poll/at_end/listen
+    streaming channel contract over durable bytes, so consumers stream
+    a spooled stage output page-at-a-time instead of materializing the
+    whole file (the ack-cursor shape of the streaming exchange applied
+    to the spool; reference: ExchangeSource.read()'s incremental
+    slices).
+
+    ``start_page`` replays from mid-stream: earlier frames are still
+    DECODED (the serde stream's dictionary-pool deltas are positional)
+    but not yielded — the page-range cursor a partially-consumed
+    consumer retry resumes from."""
+
+    def __init__(self, path: str, start_page: int = 0):
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"spool file missing: {path}")
+        self.path = path
+        self.start_page = start_page
+        self._f = None
+        self._de = PageDeserializer()  # one serde stream per task file
+        self._index = 0       # frames decoded so far
+        self._ended = False
+        self._closed = False
+        #: serializes poll() (driver thread) against close() (task
+        #: abort runs the channels teardown from the RPC handler
+        #: thread) — without it a racing close could null the file
+        #: mid-read or a late poll could reopen at offset 0 against
+        #: the already-advanced serde stream
+        self._lock = threading.Lock()
+
+    def _next_frame(self):
+        if self._closed:
+            self._ended = True
+            return None
+        if self._f is None:
+            self._f = open(self.path, "rb")
+        head = self._f.read(4)
+        if not head:
+            self._f.close()
+            self._f = None
+            self._ended = True
+            return None
+        if len(head) < 4:
+            raise SpoolCorruption(
+                f"torn frame header in {self.path}")
+        (n,) = struct.unpack("<I", head)
+        blob = self._f.read(n)
+        if len(blob) < n:
+            # a published file must hold complete frames; a short
+            # read means on-disk corruption (e.g. torn by a crashed
+            # host) — losing rows silently is never acceptable
+            raise SpoolCorruption(
+                f"torn frame in {self.path}: expected {n} bytes, "
+                f"read {len(blob)}")
+        return blob
+
+    # -- streaming channel contract --------------------------------------
+
+    def poll(self):
+        with self._lock:
+            while not self._ended:
+                blob = self._next_frame()
+                if blob is None:
+                    return None
+                page = self._de.deserialize(blob)
+                self._index += 1
+                if self._index > self.start_page:
+                    return page
+            return None
+
+    def at_end(self) -> bool:
+        return self._ended
+
+    def has_page(self) -> bool:
+        return not self._ended
+
+    def listen(self):
+        return _IMMEDIATE
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            self._ended = True
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+class _ChainedSpoolCursor:
+    """One partition's producing-task files as a single page stream:
+    cursors chain in sorted task order, each with its own serde stream
+    (the per-task-file framing contract)."""
+
+    def __init__(self, paths: List[str]):
+        self._paths = list(paths)
+        self._cur: Optional[SpoolCursor] = None
+        self._closed = False
+        # same poll-vs-abort-close serialization as SpoolCursor (and
+        # it also guards a racing poll from opening a NEW cursor after
+        # close already tore the chain down)
+        self._lock = threading.Lock()
+
+    def poll(self):
+        with self._lock:
+            while True:
+                if self._closed:
+                    return None
+                if self._cur is None:
+                    if not self._paths:
+                        return None
+                    self._cur = SpoolCursor(self._paths.pop(0))
+                page = self._cur.poll()
+                if page is not None:
+                    return page
+                # a SpoolCursor poll returns None only at end of its
+                # file (durable bytes never block): advance the chain
+                self._cur.close()
+                self._cur = None
+
+    def at_end(self) -> bool:
+        return self._cur is None and not self._paths
+
+    def has_page(self) -> bool:
+        return not self.at_end()
+
+    def listen(self):
+        return _IMMEDIATE
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            if self._cur is not None:
+                self._cur.close()
+                self._cur = None
+            self._paths = []
 
 
 class SpoolCorruption(RuntimeError):
@@ -109,28 +237,43 @@ class SpoolCorruption(RuntimeError):
     rebuilds the exchange under a fresh attempt id."""
 
 
-def read_spool_task(directory: str, partition: int, task: int) -> List:
-    """One producing task's spooled pages for one partition (the merge
-    exchange reads per-task streams to preserve sort runs). A missing
-    file means the producer never PUBLISHED — losing rows silently is
-    never acceptable, so raise and let retry policy decide."""
-    path = os.path.join(directory, f"p{partition}.t{task}.bin")
-    if not os.path.exists(path):
-        raise FileNotFoundError(f"spool file missing: {path}")
-    return _read_task_file(path)
+def spool_task_cursor(directory: str, partition: int, task: int,
+                      start_page: int = 0) -> SpoolCursor:
+    """Streaming cursor over one producing task's pages for one
+    partition (the merge exchange consumes per-task cursors to
+    preserve sort runs). A missing file means the producer never
+    PUBLISHED — raise and let retry policy decide."""
+    return SpoolCursor(
+        os.path.join(directory, f"p{partition}.t{task}.bin"),
+        start_page=start_page)
 
 
-def read_spool(directory: str, partition: int) -> List:
-    """Exchange source: all producing tasks' pages for one partition
-    (reference: spi/exchange/ExchangeSource.java)."""
-    pages: List = []
+def spool_channel(directory: str, partition: int) -> _ChainedSpoolCursor:
+    """Exchange source channel: all producing tasks' pages for one
+    partition, streamed frame-per-page (reference:
+    spi/exchange/ExchangeSource.java)."""
     if not os.path.isdir(directory):
         raise FileNotFoundError(f"spool directory missing: {directory}")
     names = sorted(n for n in os.listdir(directory)
                    if n.startswith(f"p{partition}.t")
                    and n.endswith(".bin"))
-    for name in names:
-        pages.extend(_read_task_file(os.path.join(directory, name)))
+    return _ChainedSpoolCursor([os.path.join(directory, n)
+                                for n in names])
+
+
+def read_spool(directory: str, partition: int) -> List:
+    """Materializing exchange source: all producing tasks' pages for
+    one partition (the whole-list convenience over spool_channel)."""
+    chan = spool_channel(directory, partition)
+    pages: List = []
+    try:
+        while True:
+            page = chan.poll()
+            if page is None:
+                break
+            pages.append(page)
+    finally:
+        chan.close()
     return pages
 
 

@@ -31,8 +31,13 @@ Protocol (rpc.py framing; one request per connection):
                               error_type, error_code, remote_traceback,
                               memory_peak?}
   get_results     {task_id, partition}              -> header + frames
-  get_page_stream {task_id, partition, consumer_id, wait}
-                                                    -> header + frames
+  get_page_stream {task_id, partition, consumer_id, wait, cursor, ack}
+                     -> {n_pages, start, done} + frames. Ack-based
+                     cursor protocol: frames index from 0 per stream,
+                     ``cursor`` asks for frames from that index,
+                     ``ack`` releases retained frames below it — a
+                     consumer reconnecting after a torn connection
+                     replays the unacked range byte-identically
   task_status     {task_ids}                        -> {statuses}
   abort_task      {task_id}                         -> {ok}
   sync_table      {catalog, schema, table, columns, frames} -> {ok}
@@ -61,6 +66,34 @@ from typing import Dict, List, Optional
 from .rpc import recv_msg, send_frame, send_msg
 
 
+class _RetainedStream:
+    """Per-(partition, consumer) streaming output cursor: serialized
+    frames are RETAINED until the consumer acks that range, so a
+    reconnecting consumer replays from its last acked frame instead of
+    losing the pages the buffer's drain cursor already freed (the
+    "streaming pulls do not reconnect" limitation this removes).
+    Retention is bounded: the consumer acks everything it received on
+    its next poll, so at most one response batch stays parked."""
+
+    __slots__ = ("ser", "frames", "base", "sent", "lock")
+
+    def __init__(self):
+        from ..exec.serde import PageSerializer
+
+        self.ser = PageSerializer()
+        self.frames: List[bytes] = []
+        self.base = 0           # stream index of frames[0]
+        self.sent = 0           # high-water frame index ever sent
+        self.lock = threading.Lock()
+
+    def discard_acked(self, ack: int):
+        with self.lock:
+            drop = min(max(ack - self.base, 0), len(self.frames))
+            if drop:
+                del self.frames[:drop]
+                self.base += drop
+
+
 class _TaskState:
     def __init__(self):
         self.status = "running"
@@ -69,7 +102,9 @@ class _TaskState:
         self.buffer = None          # ops.output.OutputBuffer
         self.rows = 0
         self.abort = threading.Event()
-        self.serializers: Dict[tuple, object] = {}
+        #: per-(partition, consumer) retained-frame cursors for the
+        #: ack-based streaming pull protocol
+        self.streams: Dict[tuple, _RetainedStream] = {}
         self.channels: List = []    # RemoteExchangeChannels to close
         self.thread = None
         #: finished trace spans of this task (streaming tasks outlive
@@ -577,12 +612,14 @@ class WorkerServer:
                 # k-way merge (each producer buffers its run at
                 # partition 0 of its own task buffer)
                 if src.get("spool_dir"):
-                    from .spool import read_spool_task
+                    from .spool import spool_task_cursor
 
-                    return [
-                        (lambda i=i: read_spool_task(
-                            src["spool_dir"], 0, i))
-                        for i in range(len(src["locations"]))]
+                    # page-range cursors: the merge streams the durable
+                    # runs frame-per-page instead of materializing files
+                    cursors = [spool_task_cursor(src["spool_dir"], 0, i)
+                               for i in range(len(src["locations"]))]
+                    state.channels.extend(cursors)
+                    return cursors
                 if streaming:
                     chans = [RemoteExchangeChannel([loc], 0,
                                                    consumer_id=task_index,
@@ -603,10 +640,13 @@ class WorkerServer:
                 else task_index
             if src.get("spool_dir"):
                 # fault-tolerant mode: inputs replay from the durable
-                # spool — the producing worker may be gone
-                from .spool import read_spool
+                # spool — the producing worker may be gone; the cursor
+                # channel streams it frame-per-page
+                from .spool import spool_channel
 
-                return lambda: read_spool(src["spool_dir"], part)
+                chan = spool_channel(src["spool_dir"], part)
+                state.channels.append(chan)
+                return chan
             if streaming:
                 chan = RemoteExchangeChannel(
                     src["locations"], part, consumer_id=task_index,
@@ -784,15 +824,20 @@ class WorkerServer:
         sock.close()
 
     def stream_results(self, sock, req: dict):
-        """Incremental long-poll pull of one consumer's partition
-        (reference: TaskResource GET results with ack token — the drain
-        cursor in OutputBuffer.poll is the ack)."""
-        from ..exec.serde import PageSerializer
+        """Incremental long-poll pull of one consumer's partition with
+        an ACK-BASED CURSOR (reference: TaskResource GET results with
+        the ack token): ``cursor`` is the index of the first frame the
+        consumer wants, ``ack`` the range it confirms received. Frames
+        past the ack stay retained (_RetainedStream), so a connection
+        torn mid-frame reconnects and replays byte-identical frames
+        from the consumer's cursor instead of failing the query."""
         from ..ops.output import wait_readable
 
         task_id = req["task_id"]
         partition = req["partition"]
         consumer = req.get("consumer_id", 0)
+        cursor = int(req.get("cursor", 0))
+        ack = int(req.get("ack", cursor))
         deadline = time.monotonic() + float(req.get("wait", 0.5))
         with self._lock:
             state = self.tasks.get(task_id)
@@ -801,16 +846,38 @@ class WorkerServer:
                             "connection_lost": True})
             return
         buf = state.buffer
-        frames: List[bytes] = []
-        ser = state.serializers.setdefault((partition, consumer),
-                                           PageSerializer())
+        with self._lock:
+            rs = state.streams.setdefault((partition, consumer),
+                                          _RetainedStream())
+        rs.discard_acked(min(ack, cursor))
         while True:
-            while len(frames) < 64:
-                p = buf.poll(partition, consumer)
-                if p is None:
-                    break
-                frames.append(ser.serialize(p))
-            done = buf.at_end(partition, consumer)
+            with rs.lock:
+                # serialize newly-drained pages onto the retained tail
+                # (a reconnect's replay re-sends these same bytes, so
+                # one serde stream per consumer stays consistent)
+                while rs.base + len(rs.frames) - cursor < 64:
+                    p = buf.poll(partition, consumer)
+                    if p is None:
+                        break
+                    rs.frames.append(rs.ser.serialize(p))
+                start = max(cursor, rs.base)
+                frames = list(rs.frames[start - rs.base:])
+                # frames below the sent high-water mark are re-sends of
+                # a torn response: the replay-counter observability
+                replayed = max(0, min(rs.sent, start + len(frames))
+                               - start)
+                rs.sent = max(rs.sent, start + len(frames))
+            done = False
+            if buf.at_end(partition, consumer):
+                # re-check the retained tail AFTER observing at_end: a
+                # stale duplicate handler (consumer timed out and
+                # reconnected while we were parked) may have drained
+                # more pages between our snapshot and the buffer
+                # emptying — done against the stale total would drop
+                # that tail silently
+                with rs.lock:
+                    done = start + len(frames) == \
+                        rs.base + len(rs.frames)
             # status AFTER at_end: abort() follows the status write, so
             # an at_end that observed the aborted (emptied) buffer is
             # guaranteed to see status=="failed" here — a done=True
@@ -826,16 +893,16 @@ class WorkerServer:
                 break
             wait_readable(buf, timeout=min(
                 0.25, max(0.0, deadline - time.monotonic())))
+        head = {"n_pages": len(frames), "start": start, "done": done,
+                "replayed": replayed}
         if state.drop_results > 0 and frames:
-            # injected mid-frame drop on the streaming pull: the drain
-            # cursor already advanced, so the pages are unrecoverable —
-            # the consumer must classify this as connection-lost and the
-            # query must retry (streaming outputs are not durable)
+            # injected mid-frame drop on the streaming pull: the frames
+            # stay retained (unacked), so the reconnecting consumer
+            # replays them from its cursor — byte-equal, no query retry
             state.drop_results -= 1
-            self._send_torn_frame(sock, {"n_pages": len(frames),
-                                         "done": done}, frames)
+            self._send_torn_frame(sock, head, frames)
             return
-        send_msg(sock, {"n_pages": len(frames), "done": done})
+        send_msg(sock, head)
         for f in frames:
             send_frame(sock, f)
 

@@ -185,6 +185,12 @@ def add_driver_spans(tracer: Tracer, driver, parent) -> int:
     anchor = getattr(driver, "epoch_anchor", None)
     if anchor is None:
         return 0
+    # pull operator-reported metrics (exchange flow/replay counters)
+    # into the stats entries so the spans carry them — streaming output
+    # drivers have no other stats-rendering path
+    collect = getattr(driver, "collect_operator_metrics", None)
+    if collect is not None:
+        collect()
     epoch0, pc0 = anchor
     parent_id = parent.span_id if isinstance(parent, Span) else \
         parse_context(parent)[1]
@@ -204,6 +210,13 @@ def add_driver_spans(tracer: Tracer, driver, parent) -> int:
                       "span_kind": "operator",
                       "last_activity": epoch0 + (st.last_ns - pc0) / 1e9},
         }
+        if st.metrics:
+            for key in ("kind", "first_page_ms", "reconnects",
+                        "replayed_frames", "skew_ratio",
+                        "lane_skew_ratio", "splits", "rebalances",
+                        "source_fragment"):
+                if st.metrics.get(key) is not None:
+                    span["attrs"][f"exchange_{key}"] = st.metrics[key]
         tracer._record(span)
         n += 1
     return n
