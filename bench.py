@@ -44,7 +44,11 @@ line with spill/kill counters, rc=5 on mismatch); BENCH_ROLE=skew
 (adversarial-skew smoke: zipf-keyed device exchange with
 hot-partition splitting vs the unsplit oracle + scaled-writer CTAS
 vs the unscaled oracle, SKEW_RESULT line with split/rebalance
-counters and rows/s, rc=6 on mismatch); BENCH_ROLE=trace / BENCH_TRACE=1
+counters and rows/s, rc=6 on mismatch); BENCH_ROLE=kernels (kernel-
+strategy NDV sweep: matmul join vs sorted-index byte-equal + the three
+SQL join strategies agree, global-hash aggregation vs exchange+scatter
+vs host oracle, KERNELS_RESULT line with per-NDV rows/s and the
+measured crossover NDVs, rc=9 on mismatch); BENCH_ROLE=trace / BENCH_TRACE=1
 (distributed-tracing smoke: 2-worker ProcessQueryRunner join with
 query tracing, writes the Perfetto-loadable Chrome-trace artifact to
 BENCH_TRACE_PATH [default ./BENCH_TRACE.json], emits a
@@ -386,6 +390,254 @@ def _skew_smoke() -> dict:
     print("SKEW_RESULT " + json.dumps(out), flush=True)
     if not out["ok"]:
         raise SystemExit(6)
+    return out
+
+
+def _kernels_smoke() -> dict:
+    """BENCH_ROLE=kernels: NDV-sweep microbench of the kernel-strategy
+    matrix (round 12).
+
+    Join: over low->high NDV, the matmul strategy (blocked one-hot
+    probe, ops/matmul_join.py) must produce byte-identical rows to the
+    sorted-index oracle, and the three SQL-level strategies — broadcast
+    sorted-index, partitioned sorted-index, matmul — must agree on a
+    real distributed join.  Aggregation: the global-hash replicated
+    table (ops/global_hash_agg.py) must match the exchange+scatter
+    shape and the host oracle at every NDV.  Reports per-NDV rows/s
+    for both strategies and the measured crossover (largest NDV where
+    the new kernel still wins ON THIS HOST — the number the cost-model
+    thresholds are judged against).  rc=9 on any mismatch."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from functools import partial
+
+    from trino_tpu import types as T
+    from trino_tpu.block import DevicePage, Page, padded_size
+    from trino_tpu.ops.join import (HashBuilderOperator, JoinBridge,
+                                    LookupJoinOperator)
+    from trino_tpu.ops.matmul_join import MatmulJoinOperator
+    from trino_tpu.ops.global_hash_agg import (EMPTY, global_hash_insert,
+                                               global_hash_reduce,
+                                               pack_keys)
+    from trino_tpu.parallel.exchange import (hash_partition_ids,
+                                             repartition_a2a, shard_map)
+
+    t0 = time.time()
+    rng = np.random.default_rng(11)
+    ok = True
+
+    # --- join sweep -------------------------------------------------
+    def run_join(op_cls, bkeys, bvals, pkeys, pvals, **kw):
+        bridge = JoinBridge()
+        build = HashBuilderOperator([T.BIGINT, T.BIGINT], [0], bridge)
+        build.add_input(DevicePage.from_page(Page.from_pylists(
+            [T.BIGINT, T.BIGINT], [bkeys, bvals])))
+        build.finish()
+        build.get_output()
+        op = op_cls([T.BIGINT, T.BIGINT], [0], bridge, "inner", **kw)
+
+        def probe():
+            rows = 0
+            for lo in range(0, len(pkeys), 16384):
+                op.add_input(DevicePage.from_page(Page.from_pylists(
+                    [T.BIGINT, T.BIGINT],
+                    [pkeys[lo:lo + 16384], pvals[lo:lo + 16384]])))
+                while True:
+                    p = op.get_output()
+                    if p is None:
+                        break
+                    rows += p.count()
+            return rows
+
+        t = time.perf_counter()
+        n_out = probe()
+        wall = time.perf_counter() - t
+        op.finish()
+        tail = []
+        while not op.is_finished():
+            p = op.get_output()
+            if p is not None:
+                tail.append(p)
+        n_out += sum(p.count() for p in tail)
+        return n_out, wall, op
+
+    join_sweep = []
+    join_crossover = 0
+    n_build, n_probe = 20_000, 32_768
+    for ndv in (16, 512, 8192):
+        bkeys = rng.integers(0, ndv, n_build).tolist()
+        bvals = rng.integers(0, 1000, n_build).tolist()
+        pkeys = rng.integers(0, int(ndv * 1.2) + 2, n_probe).tolist()
+        pvals = rng.integers(0, 1000, n_probe).tolist()
+        # warm both compile caches, then measure
+        for _ in range(2):
+            n_si, w_si, _ = run_join(LookupJoinOperator, bkeys, bvals,
+                                     pkeys, pvals)
+            n_mm, w_mm, mm = run_join(MatmulJoinOperator, bkeys, bvals,
+                                      pkeys, pvals,
+                                      max_key_range=1 << 15)
+        if mm.metrics().get("strategy") != "matmul" or n_mm != n_si:
+            ok = False
+        rate_si, rate_mm = n_probe / w_si, n_probe / w_mm
+        join_sweep.append({"ndv": ndv,
+                           "sorted_rows_per_s": round(rate_si, 1),
+                           "matmul_rows_per_s": round(rate_mm, 1),
+                           "out_rows": n_mm})
+        if rate_mm >= rate_si:
+            join_crossover = ndv
+
+    # the three SQL-level join strategies agree on a real distributed
+    # join (broadcast / partitioned sorted-index vs forced matmul)
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.parallel.distributed import DistributedQueryRunner
+    from trino_tpu.sql.analyzer import Session
+
+    sql = ("select c.c_custkey, o.o_orderkey from customer c "
+           "join orders o on c.c_custkey = o.o_custkey")
+
+    def run_sql(**props):
+        s = Session(catalog="tpch", schema="micro")
+        s.properties.update(props)
+        r = DistributedQueryRunner(
+            {"tpch": TpchConnector(page_rows=4096)}, s, n_workers=2,
+            desired_splits=4)
+        return sorted(r.execute(sql).rows)
+
+    via_broadcast = run_sql(join_distribution_type="BROADCAST",
+                            join_strategy="SORTED_INDEX")
+    via_partitioned = run_sql(join_distribution_type="PARTITIONED",
+                              join_strategy="SORTED_INDEX")
+    via_matmul = run_sql(join_strategy="MATMUL")
+    join_sql_ok = via_broadcast == via_partitioned == via_matmul \
+        and len(via_matmul) > 0
+    ok = ok and join_sql_ok
+
+    # --- aggregation sweep ------------------------------------------
+    n_dev = 8
+    devices = jax.devices()[:n_dev]
+    mesh = Mesh(np.asarray(devices), ("x",))
+    rows_per_dev = 16_384
+
+    def agg_programs(ndv, per_dest):
+        table_size = padded_size(2 * ndv, minimum=max(16, n_dev))
+
+        @partial(shard_map, mesh=mesh, in_specs=(P("x"),) * 3,
+                 out_specs=(P("x"),) * 3, check_vma=False)
+        def via_global_hash(k, v, va):
+            k, v, va = k[0], v[0], va[0]
+            packed = pack_keys([k], [None], (32,))
+            table, slot_of, resolved, _unres = global_hash_insert(
+                packed, va, table_size, axis_name="x")
+            sums, cnts = global_hash_reduce(
+                slot_of, resolved, va,
+                (jnp.where(va, v, 0), va.astype(jnp.int64)),
+                ("sum", "sum"), table_size, axis_name="x")
+            i = jax.lax.axis_index("x")
+            sh = table_size // n_dev
+            sl = lambda a: jax.lax.dynamic_slice(a, (i * sh,), (sh,))  # noqa: E731
+            return sl(table)[None], sl(sums)[None], sl(cnts)[None]
+
+        @partial(shard_map, mesh=mesh, in_specs=(P("x"),) * 3,
+                 out_specs=(P("x"),) * 3, check_vma=False)
+        def via_exchange(k, v, va):
+            k, v, va = k[0], v[0], va[0]
+            part = hash_partition_ids([k.astype(jnp.int64)
+                                       .view(jnp.uint64)], n_dev)
+            (rk, rv), (_nk, _nv), rva, _ovf = repartition_a2a(
+                (k, jnp.where(va, v, 0)),
+                (jnp.zeros(k.shape, bool), jnp.zeros(v.shape, bool)),
+                va, part, num_partitions=n_dev, per_dest=per_dest)
+            # received rows group into the dense key table (keys are
+            # [0, ndv) in this bench — the merge-final analog)
+            idx = jnp.where(rva, rk, ndv).astype(jnp.int32)
+            sums = jnp.zeros((ndv + 1,), jnp.int64).at[idx].add(rv)
+            cnts = jnp.zeros((ndv + 1,), jnp.int64).at[idx].add(
+                rva.astype(jnp.int64))
+            return (sums[:ndv][None], cnts[:ndv][None],
+                    jnp.sum(rva.astype(jnp.int32))[None])
+
+        return jax.jit(via_global_hash), jax.jit(via_exchange)
+
+    agg_sweep = []
+    agg_crossover = 0
+    for ndv in (16, 1024, 16384):
+        keys = rng.integers(0, ndv, (n_dev, rows_per_dev))
+        vals = rng.integers(0, 1000,
+                            (n_dev, rows_per_dev)).astype(np.int64)
+        valid = np.ones((n_dev, rows_per_dev), dtype=bool)
+        want_sum = np.zeros(ndv, np.int64)
+        want_cnt = np.zeros(ndv, np.int64)
+        np.add.at(want_sum, keys.reshape(-1), vals.reshape(-1))
+        np.add.at(want_cnt, keys.reshape(-1), 1)
+        # per_dest: exact max (sender, dest) load, computed on host —
+        # the count-first sizing pass for free (keys are host-side)
+        h = np.zeros((n_dev, n_dev), np.int64)
+        part_host = np.asarray(hash_partition_ids(
+            [jnp.asarray(keys.reshape(-1)).astype(jnp.int64)
+             .view(jnp.uint64)], n_dev)).reshape(n_dev, rows_per_dev)
+        for d in range(n_dev):
+            for p_ in range(n_dev):
+                h[d, p_] = int(np.sum(part_host[d] == p_))
+        per_dest = padded_size(int(h.max()))
+        gh, ex = agg_programs(ndv, per_dest)
+        k_j, v_j, va_j = (jnp.asarray(keys), jnp.asarray(vals),
+                          jnp.asarray(valid))
+        for _ in range(2):  # warm, then measure
+            tg = time.perf_counter()
+            t_, s_, c_ = gh(k_j, v_j, va_j)
+            jax.block_until_ready(s_)
+            w_gh = time.perf_counter() - tg
+            te = time.perf_counter()
+            es, ec, _rows = ex(k_j, v_j, va_j)
+            jax.block_until_ready(es)
+            w_ex = time.perf_counter() - te
+        # verify both against the host oracle
+        t_, s_, c_ = (np.asarray(t_).reshape(-1),
+                      np.asarray(s_).reshape(-1),
+                      np.asarray(c_).reshape(-1))
+        gh_sum = np.zeros(ndv, np.int64)
+        gh_cnt = np.zeros(ndv, np.int64)
+        occ = t_ != np.uint64(EMPTY)
+        kslot = ((t_[occ] & np.uint64(0xFFFFFFFF)) - 1).astype(np.int64)
+        gh_sum[kslot] = s_[occ]
+        gh_cnt[kslot] = c_[occ]
+        es, ec = (np.asarray(es).reshape(n_dev, ndv),
+                  np.asarray(ec).reshape(n_dev, ndv))
+        ex_sum, ex_cnt = es.sum(axis=0), ec.sum(axis=0)
+        if not (np.array_equal(gh_sum, want_sum)
+                and np.array_equal(gh_cnt, want_cnt)
+                and np.array_equal(ex_sum, want_sum)
+                and np.array_equal(ex_cnt, want_cnt)):
+            ok = False
+        total = n_dev * rows_per_dev
+        agg_sweep.append({"ndv": ndv,
+                          "global_hash_rows_per_s":
+                              round(total / w_gh, 1),
+                          "exchange_rows_per_s":
+                              round(total / w_ex, 1)})
+        if w_gh <= w_ex:
+            agg_crossover = ndv
+
+    out = {
+        "ok": ok,
+        "join_sql_three_strategies_equal": join_sql_ok,
+        "join_sweep": join_sweep,
+        "join_crossover_ndv": join_crossover,
+        "agg_sweep": agg_sweep,
+        "agg_crossover_ndv": agg_crossover,
+        "wall_s": round(time.time() - t0, 2),
+    }
+    print("KERNELS_RESULT " + json.dumps(out), flush=True)
+    if not ok:
+        raise SystemExit(9)
     return out
 
 
@@ -781,6 +1033,8 @@ if __name__ == "__main__":
         _memory_smoke()
     elif os.environ.get("BENCH_ROLE") == "skew":
         _skew_smoke()
+    elif os.environ.get("BENCH_ROLE") == "kernels":
+        _kernels_smoke()
     elif os.environ.get("BENCH_ROLE") == "trace":
         _trace_smoke()
     else:

@@ -178,6 +178,32 @@ def test_bench_skew_smoke_child():
 
 
 @pytest.mark.slow
+def test_bench_kernels_smoke_child():
+    """The bench harness's kernel-strategy role (BENCH_ROLE=kernels):
+    the matmul join must byte-match the sorted-index oracle across the
+    NDV sweep, the three SQL-level join strategies must agree, the
+    global-hash aggregation must match the exchange shape and the host
+    oracle, and the crossover NDVs must be reported — run as the real
+    child process so the kernel-strategy paths cannot rot outside the
+    test suite."""
+    env = dict(os.environ, BENCH_ROLE="kernels", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=580)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [line for line in proc.stdout.splitlines()
+             if line.startswith("KERNELS_RESULT ")]
+    assert len(lines) == 1, proc.stdout[-2000:]
+    out = json.loads(lines[0][len("KERNELS_RESULT "):])
+    assert out["ok"] is True
+    assert out["join_sql_three_strategies_equal"] is True
+    assert len(out["join_sweep"]) == 3
+    assert all(r["matmul_rows_per_s"] > 0 for r in out["join_sweep"])
+    assert len(out["agg_sweep"]) == 3
+    assert "join_crossover_ndv" in out and "agg_crossover_ndv" in out
+
+
+@pytest.mark.slow
 def test_bench_measure_child_micro_cpu():
     env = dict(os.environ, BENCH_ROLE="measure", BENCH_PLATFORM="cpu",
                BENCH_SCHEMA="micro", BENCH_QUERIES="q1,q18",

@@ -353,6 +353,36 @@ def test_rebalancer_scales_hot_partition_and_does_not_flap():
     assert calm.rebalances == 0
 
 
+def test_rebalancer_unscales_cooled_partition():
+    """The reverse transition: a hot partition that SCALED over extra
+    writer lanes releases them again once its load cools (same
+    hysteresis window), and the flap guard holds — a stationary
+    distribution, hot or cooled, converges and stays put."""
+    from trino_tpu.parallel.rebalancer import UniformPartitionRebalancer
+
+    reb = UniformPartitionRebalancer(8, 4, min_collectives=2)
+    hot = [9000, 50, 40, 60, 30, 45, 55, 35]
+    _feed(reb, hot, 10)
+    scaled_lanes = len(reb.lanes_for(0))
+    assert scaled_lanes >= 2
+    stable_hot = reb.assignment()
+    # keep feeding the SAME hot distribution: no un-scale (no flap)
+    _feed(reb, hot, 6)
+    assert reb.assignment() == stable_hot
+    # the partition cools to the pack: lanes come back, one per
+    # hysteresis window, down to a single lane
+    cool = [50, 50, 40, 60, 30, 45, 55, 35]
+    trail = _feed(reb, cool, 24)
+    assert len(reb.lanes_for(0)) == 1
+    # converged again: the cooled layout stops changing
+    assert trail[-1] == trail[-2] == trail[-3]
+    # determinism: an identical history reproduces the transitions
+    reb2 = UniformPartitionRebalancer(8, 4, min_collectives=2)
+    _feed(reb2, hot, 16)
+    _feed(reb2, cool, 24)
+    assert reb2.assignment() == reb.assignment()
+
+
 def test_rebalancer_hysteresis_respects_min_collectives():
     from trino_tpu.parallel.rebalancer import UniformPartitionRebalancer
 

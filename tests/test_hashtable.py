@@ -340,3 +340,51 @@ def test_adaptive_partial_stays_on_for_reducing_input():
     assert partial.path_counts["passthrough"] == 0
     want = _run_single(AGG_TYPES, cols, [0], AGG_SUITE, False)
     _assert_rows_equal(got, want)
+
+
+def test_adaptive_per_key_range_splits_skewed_stream():
+    """'Partial Partial Aggregates': a skewed stream — one hot key
+    carrying ~40% of rows plus an all-distinct tail — must flip only
+    its COLD key ranges to pass-through (range-split mode), keep
+    aggregating the hot range, and still produce the oracle's final
+    rows."""
+    rng = np.random.default_rng(31)
+    n = 1536
+    hot = rng.random(n) < 0.4
+    uniq = rng.permutation(n * 50)[:n] + 100
+    keys = [7 if h else int(u) for h, u in zip(hot, uniq)]
+    s1, s2 = _payload(rng, n)
+    cols = [keys, s1, s2]
+    partial, got = _run_partial_final(
+        AGG_TYPES, cols, [0], AGG_SUITE, 256,
+        adaptive=True, adaptive_min_rows=512, adaptive_ratio=0.6)
+    # mixed verdicts: the stream SPLIT instead of flipping wholesale
+    assert partial._pass_buckets is not None
+    assert not partial.passthrough
+    assert partial.path_counts["range_split"] > 0
+    m = partial.metrics()
+    assert m["adaptive"].startswith("range-split")
+    assert m["grouping_paths"]["range_split"] > 0
+    want = _run_single(AGG_TYPES, cols, [0], AGG_SUITE, False)
+    _assert_rows_equal(got, want)
+
+
+def test_adaptive_single_bucket_keeps_legacy_whole_stream_decision():
+    """adaptive_key_buckets=1 is the PR 1 behavior: one global
+    verdict, never a range split."""
+    rng = np.random.default_rng(37)
+    n = 1200
+    keys = [int(v) for v in rng.permutation(n * 50)[:n]]
+    s1, s2 = _payload(rng, n)
+    partial_ = HashAggregationOperator(
+        AGG_TYPES, [0], AGG_SUITE, "partial", adaptive_partial=True,
+        adaptive_min_rows=256, adaptive_ratio=0.5,
+        adaptive_key_buckets=1)
+    for lo in range(0, n, 256):
+        chunk = [c[lo:lo + 256] for c in [keys, s1, s2]]
+        partial_.add_input(DevicePage.from_page(
+            Page.from_pylists(AGG_TYPES, chunk)))
+        while partial_.get_output() is not None:
+            pass
+    assert partial_.passthrough
+    assert partial_._pass_buckets is None

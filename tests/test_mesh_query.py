@@ -53,18 +53,63 @@ def test_mesh_q1_repeat_run_does_not_retrace():
 
 
 def test_mesh_q1_count_first_sizing_zero_retries():
-    """Default (count-first) sizing: stage 1's histogram collective
+    """Count-first sizing on the exchange shape (pinned: q1's low NDV
+    would otherwise pick global-hash): stage 1's histogram collective
     picks per_dest exactly, so the data all_to_all runs ONCE with zero
     doubling retries, and the skew stats come back filled."""
     devices = jax.devices("cpu")[:4]
     stats = {}
     rows, retries, _conn, _pages = run_q1_mesh(devices, schema="micro",
-                                               stats_out=stats)
+                                               stats_out=stats,
+                                               agg_strategy="exchange")
     assert retries == 0
     assert len(rows) == 4
     assert stats["sizing"] == "exact"
+    assert stats["agg_strategy"] == "exchange"
     assert stats["data_collectives"] == 1
     assert stats["per_dest"] >= stats["observed_max_pair_rows"]
     assert len(stats["partition_rows"]) == 4
     assert sum(stats["partition_rows"]) == stats["rows"] > 0
     assert stats["skew_ratio"] >= 1.0
+
+
+def test_mesh_q1_auto_picks_global_hash_and_matches_exchange():
+    """q1's 4 groups sit deep in the global-hash win region: 'auto'
+    must pick the replicated-table shape (stage-1 observed groups
+    through the choose_agg_strategy cost rule), produce the exact
+    rows of the pinned exchange shape, and report the estimate that
+    picked it."""
+    devices = jax.devices("cpu")[:4]
+    stats = {}
+    rows, retries, _conn, _pages = run_q1_mesh(devices, schema="micro",
+                                               stats_out=stats)
+    assert retries == 0
+    assert stats["agg_strategy"] == "global-hash"
+    assert "groups" in stats["strategy_detail"]
+    assert stats["table_slots"] >= 2 * 4
+    want, _r, _c, _p = run_q1_mesh(devices, schema="micro",
+                                   agg_strategy="exchange")
+
+    def key(r):
+        return (r[0], r[1])
+
+    got_s, want_s = sorted(rows, key=key), sorted(want, key=key)
+    assert len(got_s) == len(want_s) == 4
+    for g, w in zip(got_s, want_s):
+        for a, b in zip(g, w):
+            if isinstance(a, float):
+                assert abs(a - b) <= 1e-9 * max(1.0, abs(b)), (g, w)
+            else:
+                assert a == b, (g, w)
+    # repeat run: the memoized program + the kernel-sizing history's
+    # table bucket must hold — a fresh table size per run would
+    # re-trace the whole SPMD program every invocation
+    from trino_tpu import jit_stats
+
+    before = jit_stats.total_for("mesh_q1_global_hash",
+                                 "global_hash_insert",
+                                 "global_hash_reduce")
+    run_q1_mesh(devices, schema="micro", agg_strategy="global_hash")
+    assert jit_stats.total_for("mesh_q1_global_hash",
+                               "global_hash_insert",
+                               "global_hash_reduce") == before

@@ -628,6 +628,15 @@ def test_gate_passes_are_not_blind_on_the_real_repo(repo_findings):
     assert any(e.kind == "shard_map" for e in entries.values())
     assert "trino_tpu.parallel.device_exchange:_exchange_program.prog" \
         in entries
+    # the kernel-strategy entry points (round 12) must be inside the
+    # trace-purity walk — the matmul probe, the global-hash claim loop,
+    # and the per-key-range adaptive kernels are all hot jit'd code
+    for entry in ("trino_tpu.ops.matmul_join:_matmul_lo_count",
+                  "trino_tpu.ops.global_hash_agg:global_hash_insert",
+                  "trino_tpu.ops.global_hash_agg:global_hash_reduce",
+                  "trino_tpu.ops.aggregation:_bucket_reduction_stats",
+                  "trino_tpu.parallel.mesh_query:q1_global_hash_fn.dist"):
+        assert entry in entries, entry
     cached = _cached_functions(index)
     assert "trino_tpu.parallel.device_exchange:_exchange_program" \
         in cached

@@ -48,6 +48,18 @@ class OperatorStats:
                 f"{self.compile_count} compiles")
         if self.metrics:
             m = self.metrics
+            if m.get("strategy"):
+                # kernel-strategy operators report what RAN (incl. a
+                # fallback) plus the cost-model estimate that picked it
+                base += f" [strategy {m['strategy']}"
+                for k in ("estimate", "fallback", "key_range"):
+                    if m.get(k):
+                        base += f" {k}={m[k]!r}"
+                base += "]"
+            if m.get("adaptive"):
+                # the adaptive partial-agg decision (pass-through or
+                # per-key-range split) — no 'strategy' key on agg ops
+                base += f" [adaptive {m['adaptive']}]"
             extras = " ".join(
                 f"{k}={m[k]}" for k in ("skew_ratio", "lane_skew_ratio",
                                         "per_dest", "a2a_retries",

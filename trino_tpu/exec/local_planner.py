@@ -17,10 +17,11 @@ from .. import types as T
 from ..block import Page
 from ..expr.compiler import PageProcessor
 from ..expr.ir import Call, InputRef, Literal, RowExpression
-from ..ops.aggregation import (ADAPTIVE_MIN_ROWS,
+from ..ops.aggregation import (ADAPTIVE_KEY_BUCKETS, ADAPTIVE_MIN_ROWS,
                                ADAPTIVE_RATIO_THRESHOLD, AggCall,
                                HashAggregationOperator)
 from ..ops.join import HashBuilderOperator, JoinBridge, LookupJoinOperator
+from ..ops.matmul_join import MatmulJoinOperator
 from ..ops.operator import (DeferredPagesSourceOperator,
                             EnforceSingleRowOperator, FilterProjectOperator,
                             LimitOperator, OffsetOperator, Operator,
@@ -51,7 +52,7 @@ def create_table_idempotent(conn, schema: str, table: str, columns):
 
 
 def grouping_options(props: Dict) -> Dict:
-    """LocalExecutionPlanner grouping kwargs from a raw
+    """LocalExecutionPlanner grouping/kernel kwargs from a raw
     session-properties mapping, with registered defaults applied — the
     ONE place the property names map to planner knobs (every runner
     builds its planners through this, so the sites cannot drift)."""
@@ -66,6 +67,10 @@ def grouping_options(props: Dict) -> Dict:
             "adaptive_partial_aggregation_unique_rows_ratio_threshold"),
         "adaptive_partial_min_rows": SP.prop_value(
             props, "adaptive_partial_aggregation_min_rows"),
+        "adaptive_partial_buckets": SP.prop_value(
+            props, "adaptive_partial_aggregation_key_range_buckets"),
+        "matmul_max_key_range": SP.prop_value(
+            props, "matmul_join_max_key_range"),
     }
 
 
@@ -113,7 +118,9 @@ class LocalExecutionPlanner:
                  scan_coalesce: bool = True,
                  adaptive_partial_agg: bool = True,
                  adaptive_partial_ratio: float = ADAPTIVE_RATIO_THRESHOLD,
-                 adaptive_partial_min_rows: int = ADAPTIVE_MIN_ROWS):
+                 adaptive_partial_min_rows: int = ADAPTIVE_MIN_ROWS,
+                 adaptive_partial_buckets: int = ADAPTIVE_KEY_BUCKETS,
+                 matmul_max_key_range: int = 1024):
         self.metadata = metadata
         self.desired_splits = desired_splits
         self.task_id = task_id
@@ -131,6 +138,11 @@ class LocalExecutionPlanner:
         self.adaptive_partial_agg = adaptive_partial_agg
         self.adaptive_partial_ratio = adaptive_partial_ratio
         self.adaptive_partial_min_rows = adaptive_partial_min_rows
+        self.adaptive_partial_buckets = adaptive_partial_buckets
+        #: densest key domain the matmul join strategy may one-hot
+        #: encode (``matmul_join_max_key_range``) — the operator's
+        #: runtime re-check of the cost model's range estimate
+        self.matmul_max_key_range = matmul_max_key_range
         #: override for write sinks: ``factory(TableWriterNode) -> sink``
         #: — the multi-process runtime routes worker writes to the
         #: coordinator's catalog through this (page-sink RPC)
@@ -250,7 +262,8 @@ class LocalExecutionPlanner:
 
     def _v_JoinNode(self, node: JoinNode):
         return self._plan_join(node.join_type, node.left, node.right,
-                               node.criteria, node.filter_expr)
+                               node.criteria, node.filter_expr,
+                               node.strategy, node.strategy_detail)
 
     def _v_CrossJoinNode(self, node: CrossJoinNode):
         # const-key equi join (build side replicated once)
@@ -259,7 +272,9 @@ class LocalExecutionPlanner:
 
     def _plan_join(self, join_type: str, left: PlanNode, right: PlanNode,
                    criteria: List[Tuple[Symbol, Symbol]],
-                   filter_expr: Optional[RowExpression]):
+                   filter_expr: Optional[RowExpression],
+                   strategy: str = "sorted-index",
+                   strategy_detail: str = ""):
         build_dfs = []
         if self.dynamic_filtering:
             from .dynamic_filter import plan_dynamic_filters
@@ -315,10 +330,21 @@ class LocalExecutionPlanner:
                 pred)
             filter_fn = proc.process
 
-        pops.append(LookupJoinOperator(
-            ptypes, probe_keys, bridge, join_type, filter_fn,
-            max_lanes=self.join_max_lanes,
-            memory_limited=self._memory_constrained()))
+        if strategy == "matmul":
+            # the cost model picked the blocked one-hot matmul probe;
+            # the operator re-checks the actual key range per build and
+            # falls back to the sorted index (reason in its metrics)
+            pops.append(MatmulJoinOperator(
+                ptypes, probe_keys, bridge, join_type, filter_fn,
+                max_lanes=self.join_max_lanes,
+                memory_limited=self._memory_constrained(),
+                max_key_range=self.matmul_max_key_range,
+                strategy_detail=strategy_detail))
+        else:
+            pops.append(LookupJoinOperator(
+                ptypes, probe_keys, bridge, join_type, filter_fn,
+                max_lanes=self.join_max_lanes,
+                memory_limited=self._memory_constrained()))
         if join_type in ("semi", "anti"):
             out_layout = dict(playout)
             out_types = ptypes
@@ -367,7 +393,8 @@ class LocalExecutionPlanner:
             hash_grouping=self.hash_grouping,
             adaptive_partial=self.adaptive_partial_agg,
             adaptive_ratio=self.adaptive_partial_ratio,
-            adaptive_min_rows=self.adaptive_partial_min_rows)
+            adaptive_min_rows=self.adaptive_partial_min_rows,
+            adaptive_key_buckets=self.adaptive_partial_buckets)
         ops.append(op)
         new_layout = {}
         out_types = []

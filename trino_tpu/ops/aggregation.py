@@ -34,6 +34,16 @@ reduction ratio; once enough rows show grouping is not reducing
 in the intermediate keys+states layout — the final step re-groups, so
 results are unchanged while the partial stops burning time on
 high-cardinality keys.
+
+**Per-key-range decision** ("Partial Partial Aggregates" proper): the
+observation window tracks the reduction ratio PER KEY-RANGE BUCKET
+(the hashed key space split into ``adaptive_key_buckets`` ranges), and
+the pass-through switch flips per bucket — a skewed stream keeps
+aggregating its hot (duplicate-heavy) ranges while cold (mostly-
+unique) ranges pass through ungrouped, instead of one all-or-nothing
+stream decision.  A decided split emits two pages per input page (the
+aggregated hot-range partial + the cold-range pass-through), both in
+the intermediate layout the final step re-groups anyway.
 """
 
 from __future__ import annotations
@@ -50,8 +60,8 @@ from .. import jit_stats
 from .. import types as T
 from ..block import DevicePage, padded_size
 from ..types import TypeError_
-from .hashtable import (hash_group_ids, hash_segment_reduce,
-                        hashable_key_types)
+from .hashtable import (_mix_operands, hash_group_ids,
+                        hash_segment_reduce, hashable_key_types)
 from .operator import Operator
 from .sortkeys import group_operands
 
@@ -60,6 +70,10 @@ from .sortkeys import group_operands
 ADAPTIVE_MIN_ROWS = 100_000
 #: groups/rows ratio above which the partial step stops aggregating
 ADAPTIVE_RATIO_THRESHOLD = 0.9
+#: key-range buckets the pass-through decision is made over (1 = one
+#: global per-stream decision; ``adaptive_partial_aggregation_key_
+#: range_buckets``)
+ADAPTIVE_KEY_BUCKETS = 8
 
 
 # ---------------------------------------------------------------------------
@@ -397,6 +411,38 @@ def _group_reduce(key_ops: Tuple, key_raws: Tuple, state_cols: Tuple,
     return out_key_raws, out_key_nulls, tuple(reduced), out_valid
 
 
+@partial(jax.jit, static_argnames=("buckets",))
+def _bucket_reduction_stats(key_ops: Tuple, valid, group_rows, ngroups,
+                            buckets: int):
+    """(2, buckets) per-key-range observation of one page: row 0 =
+    live rows per bucket, row 1 = groups (leader rows) per bucket.
+    The bucket is a stable hash of the grouping operands, so a key's
+    rows land in the same range bucket on every page.  Sums across
+    axis 1 give the page totals, so this is the ONE host fetch the
+    adaptive window pays per observed page."""
+    jit_stats.bump("agg_bucket_stats")
+    cap = valid.shape[0]
+    b = (_mix_operands(key_ops, cap)
+         % np.uint64(buckets)).astype(jnp.int32)
+    rows = jnp.zeros((buckets + 1,), dtype=jnp.int32)
+    rows = rows.at[jnp.where(valid, b, buckets)].add(1)
+    leader = jnp.arange(cap, dtype=jnp.int32) < ngroups
+    lb = b[group_rows]
+    groups = jnp.zeros((buckets + 1,), dtype=jnp.int32)
+    groups = groups.at[jnp.where(leader, lb, buckets)].add(1)
+    return jnp.stack([rows[:buckets], groups[:buckets]])
+
+
+@partial(jax.jit, static_argnames=("buckets",))
+def _key_range_pass_mask(key_ops: Tuple, pass_buckets, buckets: int):
+    """Per-row pass-through mask from the decided per-bucket verdicts
+    (same stable hash as ``_bucket_reduction_stats``)."""
+    jit_stats.bump("agg_key_range_mask")
+    n = key_ops[0].shape[0]
+    b = (_mix_operands(key_ops, n) % np.uint64(buckets)).astype(jnp.int32)
+    return pass_buckets[b]
+
+
 class HashAggregationOperator(Operator):
     """GROUP BY over device batches (see module docstring).
 
@@ -411,7 +457,8 @@ class HashAggregationOperator(Operator):
                  memory_context=None, hash_grouping: bool = True,
                  adaptive_partial: bool = True,
                  adaptive_ratio: float = ADAPTIVE_RATIO_THRESHOLD,
-                 adaptive_min_rows: int = ADAPTIVE_MIN_ROWS):
+                 adaptive_min_rows: int = ADAPTIVE_MIN_ROWS,
+                 adaptive_key_buckets: int = ADAPTIVE_KEY_BUCKETS):
         assert step in ("single", "partial", "final")
         self.input_types = list(input_types)
         self.group_channels = list(group_channels)
@@ -421,16 +468,25 @@ class HashAggregationOperator(Operator):
         self.adaptive_partial = adaptive_partial and step == "partial"
         self.adaptive_ratio = adaptive_ratio
         self.adaptive_min_rows = adaptive_min_rows
+        self.adaptive_key_buckets = max(1, int(adaptive_key_buckets)) \
+            if group_channels else 1
         #: adaptive observation window (hash path only: the group count
         #: is already on host from the per-page stats fetch)
         self._adaptive_rows = 0
         self._adaptive_groups = 0
         self._adaptive_decided = False
+        #: per-key-range (2, buckets) accumulated [rows, groups]
+        self._bucket_stats = np.zeros((2, self.adaptive_key_buckets),
+                                      dtype=np.int64)
         #: True once the partial step switched to pass-through
         self.passthrough = False
+        #: per-bucket verdicts when the decision SPLIT the key space
+        #: (device bool (buckets,)); None = no split decided
+        self._pass_buckets = None
         self._pending: List[DevicePage] = []  # pass-through output queue
         #: pages grouped per path, for EXPLAIN/observability
-        self.path_counts = {"hash": 0, "sort": 0, "passthrough": 0}
+        self.path_counts = {"hash": 0, "sort": 0, "passthrough": 0,
+                            "range_split": 0}
         self._partials: List = []  # DevicePage | SpilledPage entries
         self._emitted = False
         self._done = False
@@ -494,7 +550,26 @@ class HashAggregationOperator(Operator):
             self.path_counts["passthrough"] += 1
             self._pending.append(self._passthrough_page(page))
             return
-        partial = self._aggregate_page(page, intermediate=intermediate)
+        key_operands = None
+        if self._pass_buckets is not None:
+            # per-key-range split: cold (mostly-unique) ranges pass
+            # through ungrouped, hot ranges keep aggregating — the
+            # final step re-groups both, so results are unchanged.
+            # The grouping operands feed both the mask and the
+            # aggregation below (they don't depend on validity), so
+            # compute them once.
+            self.path_counts["range_split"] += 1
+            key_types = [self.input_types[c] for c in self.group_channels]
+            key_operands = self._grouping_operands(
+                page, self.group_channels, key_types)
+            mask = _key_range_pass_mask(tuple(key_operands[0]),
+                                        self._pass_buckets,
+                                        self.adaptive_key_buckets)
+            self._pending.append(self._passthrough_page(
+                _masked_page(page, page.valid & mask)))
+            page = _masked_page(page, page.valid & ~mask)
+        partial = self._aggregate_page(page, intermediate=intermediate,
+                                       key_operands=key_operands)
         if self._ctx is None:
             self._partials.append(partial)
             return
@@ -511,12 +586,14 @@ class HashAggregationOperator(Operator):
         return spill_pages(self._partials, self._ctx.pool,
                            self._ctx.lock)
 
-    def _aggregate_page(self, page: DevicePage,
-                        intermediate: bool) -> DevicePage:
+    def _aggregate_page(self, page: DevicePage, intermediate: bool,
+                        key_operands=None) -> DevicePage:
         """intermediate=False: page is raw input rows (layout:
         self.input_types, keys at self.group_channels).
         intermediate=True: page is partial-agg output (layout:
-        _intermediate_types — keys at channels [0..nkeys), then states)."""
+        _intermediate_types — keys at channels [0..nkeys), then states).
+        ``key_operands``: precomputed (key_ops, key_raws) from the
+        range-split path (raw layout only) — skips recomputing them."""
         nkeys = len(self.group_channels)
         if intermediate:
             key_channels = list(range(nkeys))
@@ -525,22 +602,11 @@ class HashAggregationOperator(Operator):
             key_channels = self.group_channels
             key_types = [self.input_types[c] for c in self.group_channels]
 
-        key_ops: List = []
-        key_raws: List = []
-        for c, t in zip(key_channels, key_types):
-            col = page.cols[c]
-            if getattr(t, "is_pooled", False):
-                # group pooled keys by lexicographic RANK, not raw code:
-                # aligned (derived) pools may hold one value under
-                # several codes. The representative raw code still rides
-                # along for output.
-                rank_lut, _ = _rank_and_inverse(page.dictionaries[c])
-                ops = group_operands(jnp.asarray(rank_lut)[col],
-                                     page.nulls[c], T.BIGINT)
-            else:
-                ops = group_operands(col, page.nulls[c], t)
-            key_ops.extend(ops)
-            key_raws.append(col)
+        if key_operands is not None:
+            key_ops, key_raws = key_operands
+        else:
+            key_ops, key_raws = self._grouping_operands(
+                page, key_channels, key_types)
 
         if intermediate:
             # states laid out after the keys
@@ -592,6 +658,29 @@ class HashAggregationOperator(Operator):
             for k in range(len(self._str_state))]
         return DevicePage(types, cols, nulls, out_valid, dicts)
 
+    def _grouping_operands(self, page: DevicePage, key_channels,
+                           key_types):
+        """(key_ops, key_raws) grouping operands of one page — pooled
+        keys group by lexicographic RANK, not raw code: aligned
+        (derived) pools may hold one value under several codes.  The
+        representative raw code still rides along for output.  Also
+        the stable per-row key identity the key-range bucketing
+        hashes, so observation and split agree on every key's
+        bucket."""
+        key_ops: List = []
+        key_raws: List = []
+        for c, t in zip(key_channels, key_types):
+            col = page.cols[c]
+            if getattr(t, "is_pooled", False):
+                rank_lut, _ = _rank_and_inverse(page.dictionaries[c])
+                ops = group_operands(jnp.asarray(rank_lut)[col],
+                                     page.nulls[c], T.BIGINT)
+            else:
+                ops = group_operands(col, page.nulls[c], t)
+            key_ops.extend(ops)
+            key_raws.append(col)
+        return key_ops, key_raws
+
     def _hash_group_page(self, page: DevicePage, key_ops, key_raws,
                          key_channels, state_cols, mode: str,
                          observe: bool):
@@ -613,7 +702,8 @@ class HashAggregationOperator(Operator):
                 return None
         elif observe and self.adaptive_partial \
                 and not self._adaptive_decided:
-            self._observe_reduction(page.valid, ngroups)
+            self._observe_reduction(key_ops, page.valid, group_rows,
+                                    ngroups)
         self.path_counts["hash"] += 1
         return result
 
@@ -629,19 +719,32 @@ class HashAggregationOperator(Operator):
                 state_cols[k] = jnp.asarray(inv)[r].astype(jnp.int32)
         return state_cols
 
-    def _observe_reduction(self, valid, ngroups):
-        """Accumulate the groups/rows ratio; once enough rows show
-        grouping is not reducing, switch to pass-through (reference:
-        AggregationOperator's adaptive partial aggregation)."""
-        stats = np.asarray(jnp.stack(
-            [ngroups, jnp.sum(valid.astype(jnp.int32))]))
-        self._adaptive_groups += int(stats[0])
-        self._adaptive_rows += int(stats[1])
-        if self._adaptive_rows >= self.adaptive_min_rows:
-            self._adaptive_decided = True
-            ratio = self._adaptive_groups / max(self._adaptive_rows, 1)
-            if ratio > self.adaptive_ratio:
-                self.passthrough = True
+    def _observe_reduction(self, key_ops, valid, group_rows, ngroups):
+        """Accumulate the groups/rows ratio PER KEY-RANGE BUCKET; once
+        enough rows are observed, flip pass-through per bucket: all
+        buckets non-reducing -> whole-stream pass-through (the classic
+        switch), a mix -> range split (reference: adaptive partial
+        aggregation; "Partial Partial Aggregates", PAPERS.md)."""
+        stats = np.asarray(_bucket_reduction_stats(
+            tuple(key_ops), valid, group_rows, ngroups,
+            self.adaptive_key_buckets)).astype(np.int64)
+        self._bucket_stats += stats
+        self._adaptive_rows += int(stats[0].sum())
+        self._adaptive_groups += int(stats[1].sum())
+        if self._adaptive_rows < self.adaptive_min_rows:
+            return
+        self._adaptive_decided = True
+        rows_b, groups_b = self._bucket_stats
+        b = self.adaptive_key_buckets
+        # a bucket flips only with its share of the evidence: a range
+        # barely seen keeps aggregating (the safe default)
+        evid = rows_b >= max(1, self.adaptive_min_rows // (2 * b))
+        ratios = groups_b / np.maximum(rows_b, 1)
+        pass_b = evid & (ratios > self.adaptive_ratio)
+        if pass_b.all():
+            self.passthrough = True
+        elif pass_b.any():
+            self._pass_buckets = jnp.asarray(pass_b)
 
     def _passthrough_page(self, page: DevicePage) -> DevicePage:
         """Raw input page -> intermediate keys+states layout, ungrouped
@@ -826,8 +929,29 @@ class HashAggregationOperator(Operator):
         return [self._state_dicts[k] if self._str_state[k] else None
                 for k in range(len(self._str_state))]
 
+    def metrics(self) -> dict:
+        """Grouping-path observability for EXPLAIN ANALYZE: pages per
+        path and, once the adaptive window decided, what it decided
+        (whole-stream pass-through vs the per-key-range split)."""
+        out = {"grouping_paths": {k: v for k, v in
+                                  self.path_counts.items() if v}}
+        if self.passthrough:
+            out["adaptive"] = "passthrough"
+        elif self._pass_buckets is not None:
+            out["adaptive"] = (
+                f"range-split "
+                f"{int(np.asarray(self._pass_buckets).sum())}/"
+                f"{self.adaptive_key_buckets} buckets pass through")
+        return out
+
     def is_finished(self) -> bool:
         return self._done
+
+
+def _masked_page(page: DevicePage, valid) -> DevicePage:
+    """The same page under a different validity mask (columns shared)."""
+    return DevicePage(page.types, page.cols, page.nulls, valid,
+                      page.dictionaries)
 
 
 def _pad_to(arr, cap: int):

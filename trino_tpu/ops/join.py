@@ -422,8 +422,12 @@ class LookupJoinOperator(Operator):
                                   key_types, b.key_mode)
         pusable = page.valid & ~panynull if panynull is not None \
             else page.valid
-        lo, count = _probe_counts(b.key_sorted, b.usable_sorted, pkey,
-                                  pusable)
+        direct = self._probe_direct(page, b, pkey, pusable)
+        if direct is not None:
+            self._ready.append(direct)
+            self._added_since_get = True
+            return
+        lo, count = self._probe_lo_count(b, pkey, pusable)
         rows = int(page.valid.shape[0])
         cap = padded_size(max(16, int(rows * self._ratio * 1.1)))
         while cap > self.max_lanes and cap > 16:
@@ -436,6 +440,22 @@ class LookupJoinOperator(Operator):
             "total": jnp.sum(count), "out": out, "keep": keep,
             "bidx": bidx})
         self._added_since_get = True
+
+    def _probe_direct(self, page: DevicePage, b: "BuildSide", pkey,
+                      pusable):
+        """Strategy seam: a complete output page computed straight from
+        the probe keys (no candidate expansion), or None to run the
+        lo/count path below.  The matmul strategy
+        (``ops/matmul_join.py``) answers semi/anti membership here."""
+        return None
+
+    def _probe_lo_count(self, b: "BuildSide", pkey, pusable):
+        """Strategy seam: each probe row's candidate range (lo, count)
+        against the sorted build index — here two XLA-native vectorized
+        binary searches; the matmul strategy overrides with the blocked
+        one-hot matmul probe."""
+        return _probe_counts(b.key_sorted, b.usable_sorted, pkey,
+                             pusable)
 
     def get_output(self):
         if self._ready:

@@ -38,6 +38,10 @@ from .. import jit_stats
 from ..block import Block, Page, padded_size
 from ..ops.aggregation import (_final_project, _group_reduce, _merge_states,
                                _state_plan)
+from ..ops.global_hash_agg import (EMPTY, global_hash_insert,
+                                   global_hash_reduce, pack_keys,
+                                   unpack_keys)
+from ..ops.kernel_sizing import KERNEL_SIZING
 from ..ops.sortkeys import group_operands
 from .exchange import (hash_partition_ids, partition_histogram,
                        repartition_a2a, shard_map, subbucket_ids)
@@ -200,11 +204,82 @@ def q1_exchange_final_fn(mesh: Mesh, proc, aggs, per_dest: int):
     return jax.jit(exchanged)
 
 
+def q1_global_hash_fn(mesh: Mesh, proc, aggs, table_size: int):
+    """Build the jitted GLOBAL-HASH alternative to the exchange+final
+    program ("Global Hash Tables Strike Back!", PAPERS.md): no
+    all_to_all of partial groups at all — every device claims its
+    partial groups' slots in ONE replicated open-addressing table
+    (splitmix64 probing, pmin-agreed claims) and the state columns
+    merge by collective scatter-add (psum/pmin/pmax over the table).
+    Each device then finalizes the table shard it owns, so the output
+    layout matches the exchange path's (n, per-device) shape.  For
+    low-NDV grouping the collectives move O(table) bytes instead of
+    O(partial groups) rows."""
+    n = mesh.devices.size
+    kinds = tuple(k for a in aggs for (k, _) in _state_plan(a))
+    shard = table_size // n
+    widths = (32, 32)  # q1 keys are dictionary codes: small, non-negative
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P("x"), P("x"), P("x"), P("x")),
+             out_specs=(P("x"), P("x"), P("x"), P("x")),
+             check_vma=False)
+    def dist(kr, kn, states, pvalid):
+        kr = tuple(k[0] for k in kr)
+        kn = tuple(b[0] for b in kn)
+        states = tuple(s[0] for s in states)
+        pvalid = pvalid[0]
+        merged: List = []
+        idx = 0
+        for a in aggs:
+            k = len(_state_plan(a))
+            merged.extend(_merge_states(
+                a, [states[idx + j] for j in range(k)], pvalid))
+            idx += k
+        packed = pack_keys(kr, kn, widths)
+        table, slot_of, resolved, unresolved = global_hash_insert(
+            packed, pvalid, table_size, axis_name="x")
+        reduced = global_hash_reduce(slot_of, resolved, pvalid,
+                                     tuple(merged), kinds, table_size,
+                                     axis_name="x")
+        # finalize the owned shard: slot -> group row
+        i = jax.lax.axis_index("x")
+
+        def sl(arr):
+            return jax.lax.dynamic_slice(arr, (i * shard,), (shard,))
+
+        t_sh = sl(table)
+        occupied = t_sh != EMPTY
+        fin_cols = []
+        fin_nulls = []
+        for (kv, knull), kcol in zip(unpack_keys(t_sh, widths), kr):
+            fin_cols.append(kv.astype(kcol.dtype))
+            fin_nulls.append(knull & occupied)
+        idx = 0
+        for a in aggs:
+            k = len(_state_plan(a))
+            raw, null = _final_project(a, [sl(reduced[idx + j])
+                                           for j in range(k)])
+            fin_cols.append(raw.astype(a.output_type.storage))
+            fin_nulls.append(null | ~occupied)
+            idx += k
+        return (tuple(c[None] for c in fin_cols),
+                tuple(x[None] for x in fin_nulls),
+                occupied[None], unresolved[None])
+
+    def hashed(kr, kn, states, pvalid):
+        jit_stats.bump("mesh_q1_global_hash")
+        return dist(kr, kn, states, pvalid)
+
+    return jax.jit(hashed)
+
+
 def run_q1_mesh(devices: Sequence, schema: str = "micro",
                 per_dest: Optional[int] = None,
                 max_per_dest: int = 1 << 16,
                 stats_out: Optional[dict] = None,
-                hot_split_threshold: Optional[float] = None):
+                hot_split_threshold: Optional[float] = None,
+                agg_strategy: str = "auto"):
     """Execute distributed q1 over the mesh.
 
     ``per_dest=None`` (default) sizes the exchange count-first: stage 1
@@ -220,6 +295,16 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
     sub-bucket (aggregation-safe — every group still meets on exactly
     one device). Sizing keeps the UNSALTED count (an upper bound in
     the common case); the doubling backstop covers the remainder.
+
+    ``agg_strategy`` picks the merge shape after stage 1: 'exchange'
+    (all_to_all of partial groups + per-device merge-final — the
+    legacy shape), 'global_hash' (one replicated table updated by
+    collective scatter-add — no row shuffle), or 'auto' (default): the
+    ``planner.optimizer.choose_agg_strategy`` cost rule decides from
+    stage 1's observed live-group count.  A pinned ``per_dest`` forces
+    the exchange shape (it IS an exchange knob), and a global-hash
+    probe-budget overflow falls back to the exchange path — results
+    are identical either way.
 
     Returns (result_rows, n_overflow_retries, connector, scanned_pages) —
     the latter two so callers can re-run the same data locally for the
@@ -260,54 +345,112 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
     if per_dest is None:
         per_dest = padded_size(max(exact_need, 16))
 
-    # hot-partition split decision from stage 1's live-group histogram
-    # (the same count the sizing pass already paid for)
+    # merge-shape decision: the cost rule reads stage 1's observed
+    # live-group count (an upper bound on distinct groups — the same
+    # histogram the sizing pass already paid for)
     total_groups = int(part_rows.sum())
-    hot: set = set()
-    if hot_split_threshold is not None and hot_split_threshold < 1.0 \
-            and n > 1 and total_groups:
-        hot = {p for p in range(n)
-               if part_rows[p] / total_groups > hot_split_threshold}
-    hot_mask = np.zeros((n,), dtype=np.int32)
-    for p in hot:
-        hot_mask[p] = 1
-    hot_mask = jnp.asarray(hot_mask)
+    pinned = sizing == "legacy"
+    strategy = {"auto": "auto", "exchange": "exchange",
+                "global_hash": "global-hash",
+                "global-hash": "global-hash"}.get(agg_strategy)
+    if strategy is None:
+        from ..types import TrinoError
+
+        raise TrinoError(f"unknown agg_strategy {agg_strategy!r}",
+                         "GENERIC_INTERNAL_ERROR")
+    detail = f"forced agg_strategy={agg_strategy}"
+    if pinned and strategy != "exchange":
+        strategy, detail = "exchange", "per_dest pinned -> exchange"
+    elif strategy == "auto":
+        from ..planner.optimizer import choose_agg_strategy
+
+        strategy, detail = choose_agg_strategy(total_groups, n)
 
     retries = 0
-    collectives = 0
-    while True:
+    out_cols = out_nulls = out_valid = None
+    if strategy == "global-hash":
+        # table sized 2x the observed partial-group bound (load <= 0.5)
+        # through the kernel sizing history, so repeat runs whose group
+        # count jitters reuse the compiled program; must shard evenly
+        # over the mesh (both are powers of two)
+        table_size = KERNEL_SIZING.suggest(
+            ("global-hash-q1", tsig, n), 2 * max(total_groups, 1),
+            minimum=max(16, n))
+        if table_size % n:
+            # the table must shard evenly over the mesh (pow2 capacity
+            # over a pow2 mesh always does; an odd mesh keeps exchange)
+            strategy = "exchange"
+            detail += f"; table {table_size} !% {n} devices -> exchange"
+    if strategy == "global-hash":
         fn = _cached_program(
-            ("final", mesh, tsig, per_dest),
-            lambda: q1_exchange_final_fn(mesh, proc, aggs, per_dest))
-        out_cols, out_nulls, out_valid, overflow = fn(
-            kr, kn, states, pvalid, part, hot_mask)
+            ("global_hash", mesh, tsig, table_size),
+            lambda: q1_global_hash_fn(mesh, proc, aggs, table_size))
+        out_cols, out_nulls, out_valid, unresolved = fn(
+            kr, kn, states, pvalid)
         jax.block_until_ready(out_valid)
-        collectives += 1
-        if int(np.asarray(overflow).sum()) == 0:
-            break
-        per_dest *= 2
-        retries += 1
-        if per_dest > max_per_dest:
-            from ..types import TrinoError
+        n_unresolved = int(np.asarray(unresolved)[0])
+        if n_unresolved:
+            # probe budget exhausted (adversarial collisions): the
+            # exchange path is the exact fallback
+            strategy = "exchange"
+            detail += f"; global-hash overflow {n_unresolved} -> exchange"
+        elif stats_out is not None:
+            stats_out.update({
+                "kind": "device", "agg_strategy": "global-hash",
+                "strategy_detail": detail,
+                "table_slots": table_size,
+                "rows": total_groups,
+                "partition_rows": [int(r) for r in part_rows],
+                "a2a_retries": 0, "data_collectives": 1,
+            })
 
-            raise TrinoError(
-                f"exchange overflow persists at per_dest={per_dest}",
-                "GENERIC_INTERNAL_ERROR")
+    hot: set = set()
+    if strategy == "exchange":
+        # hot-partition split decision from stage 1's histogram
+        if hot_split_threshold is not None and hot_split_threshold < 1.0 \
+                and n > 1 and total_groups:
+            hot = {p for p in range(n)
+                   if part_rows[p] / total_groups > hot_split_threshold}
+        hot_mask = np.zeros((n,), dtype=np.int32)
+        for p in hot:
+            hot_mask[p] = 1
+        hot_mask = jnp.asarray(hot_mask)
 
-    if stats_out is not None:
-        mean_rows = float(part_rows.mean()) if n else 0.0
-        stats_out.update({
-            "kind": "device", "sizing": sizing, "per_dest": per_dest,
-            "observed_max_pair_rows": exact_need,
-            "a2a_retries": retries, "data_collectives": collectives,
-            "rows": int(part_rows.sum()),
-            "partition_rows": [int(r) for r in part_rows],
-            "skew_ratio": (round(float(part_rows.max()) / mean_rows, 3)
-                           if mean_rows > 0 else 0.0),
-            "hot_partitions": sorted(hot),
-            "splits": len(hot),
-            "split_ways": n if hot else 1,
-        })
+        collectives = 0
+        while True:
+            fn = _cached_program(
+                ("final", mesh, tsig, per_dest),
+                lambda: q1_exchange_final_fn(mesh, proc, aggs, per_dest))
+            out_cols, out_nulls, out_valid, overflow = fn(
+                kr, kn, states, pvalid, part, hot_mask)
+            jax.block_until_ready(out_valid)
+            collectives += 1
+            if int(np.asarray(overflow).sum()) == 0:
+                break
+            per_dest *= 2
+            retries += 1
+            if per_dest > max_per_dest:
+                from ..types import TrinoError
+
+                raise TrinoError(
+                    f"exchange overflow persists at per_dest={per_dest}",
+                    "GENERIC_INTERNAL_ERROR")
+
+        if stats_out is not None:
+            mean_rows = float(part_rows.mean()) if n else 0.0
+            stats_out.update({
+                "kind": "device", "sizing": sizing, "per_dest": per_dest,
+                "agg_strategy": "exchange", "strategy_detail": detail,
+                "observed_max_pair_rows": exact_need,
+                "a2a_retries": retries, "data_collectives": collectives,
+                "rows": int(part_rows.sum()),
+                "partition_rows": [int(r) for r in part_rows],
+                "skew_ratio": (round(float(part_rows.max()) / mean_rows, 3)
+                               if mean_rows > 0 else 0.0),
+                "hot_partitions": sorted(hot),
+                "splits": len(hot),
+                "split_ways": n if hot else 1,
+            })
 
     # assemble the distributed result: compact valid lanes per device
     out_types = list(proc.output_types[:2]) + [a.output_type for a in aggs]

@@ -20,9 +20,15 @@ Design points kept from the reference:
 - determinism: all choices are argmin/argmax with index tie-breaks;
   exact load ties fall to a seeded RNG, so a fixed seed reproduces the
   full assignment history;
-- scaling is monotone (a scaled partition never drops lanes) and moves
-  must strictly improve the imbalance, so every rebalance pass
-  terminates and converges.
+- scaling is monotone WITHIN a pass (a scaled partition never drops
+  lanes while any lane is hot) and moves must strictly improve the
+  imbalance, so every rebalance pass terminates and converges;
+- the REVERSE transition: once the cluster is calm, a scaled partition
+  whose smoothed load cooled releases lanes again (one per pass, same
+  hysteresis window) — but only when its per-lane share after the
+  release stays under ``unscale_factor`` x mean, strictly inside the
+  scale trigger, so scale/un-scale cannot flap on a stationary
+  distribution.
 
 Writer-side correctness does not need key co-location (each writer
 lane just appends rows; the statement row count is summed downstream),
@@ -83,6 +89,14 @@ class UniformPartitionRebalancer:
     #: observability, mirrors DeviceExchange.total_collectives)
     total_rebalances = 0
     _total_lock = threading.Lock()
+
+    #: a scaled partition releases a lane only when its per-lane share
+    #: AFTER the release stays below this fraction of the mean lane
+    #: load.  The scale trigger needs share > mean, and the mean
+    #: (total/w) is invariant under re-assignment — so any factor < 1
+    #: makes the transitions flap-free; 0.9 leaves margin for EWMA
+    #: drift while still fully un-scaling a genuinely cooled partition
+    unscale_factor = 0.9
 
     def __init__(self, n_partitions: int, n_writers: int,
                  min_collectives: int = 2, max_skew: float = 1.3,
@@ -153,6 +167,11 @@ class UniformPartitionRebalancer:
                 break
             hi = int(np.argmax(loads))  # ties -> lowest index
             if loads[hi] <= self.max_skew * mean:
+                # calm cluster: the reverse transition — give ONE
+                # cooled scaled partition a lane back (same hysteresis
+                # window as scaling; see unscale_factor)
+                if self._unscale_locked(loads, mean):
+                    changed = True
                 break
             # partitions feeding the hot lane, hottest per-lane share
             # first (deterministic: share desc, partition id asc)
@@ -183,6 +202,25 @@ class UniformPartitionRebalancer:
                 break
             changed = True
         return changed
+
+    def _unscale_locked(self, loads: np.ndarray, mean: float) -> bool:
+        """Un-scale the coldest eligible scaled partition by dropping
+        its most-loaded lane (deterministic: share-after asc, partition
+        id asc; lane load desc, lane id asc).  Eligible = the per-lane
+        share AFTER the drop stays under unscale_factor x mean, so the
+        released lanes cannot re-trip the scale condition."""
+        cand = sorted(
+            ((self._ewma[p] / (len(self._assign[p]) - 1), p)
+             for p in range(self.n) if len(self._assign[p]) > 1),
+            key=lambda t: (t[0], t[1]))
+        for share_after, p in cand:
+            if share_after >= self.unscale_factor * mean:
+                break  # ascending: nothing colder follows
+            lanes = self._assign[p]
+            drop = max(lanes, key=lambda ln: (loads[ln], -ln))
+            self._assign[p] = [ln for ln in lanes if ln != drop]
+            return True
+        return False
 
     # -- read side ------------------------------------------------------
 

@@ -114,11 +114,13 @@ class ExchangePlanner:
         keys = node.group_keys
         if dist in (SINGLE, ANY):
             return AggregationNode(src, keys, node.aggregations,
-                                   node.step), dist
+                                   node.step, None, node.strategy,
+                                   node.strategy_detail), dist
         if keys and dist == _hash(keys):
             # already partitioned on the grouping keys: aggregate locally
             return AggregationNode(src, keys, node.aggregations,
-                                   node.step), dist
+                                   node.step, None, node.strategy,
+                                   node.strategy_detail), dist
         # partial -> exchange -> final
         state_symbols: List[Symbol] = []
         for out_sym, agg in node.aggregations:
@@ -134,7 +136,8 @@ class ExchangePlanner:
             ex = ExchangeNode(partial, "single", [])
             final_dist = SINGLE
         final = AggregationNode(ex, keys, node.aggregations, "final",
-                                state_symbols)
+                                state_symbols, node.strategy,
+                                node.strategy_detail)
         return final, final_dist
 
     def _v_DistinctNode(self, node: DistinctNode):
@@ -166,7 +169,8 @@ class ExchangePlanner:
             if ldist in (SINGLE, ANY):
                 right = self._to_single(right, rdist)
                 return JoinNode(node.join_type, left, right, node.criteria,
-                                node.filter_expr), SINGLE
+                                node.filter_expr, node.strategy,
+                                node.strategy_detail), SINGLE
             partitioned = True
         elif self.join_distribution == "BROADCAST":
             partitioned = False
@@ -191,7 +195,8 @@ class ExchangePlanner:
                 right = ExchangeNode(right, "broadcast", [])
             out_dist = ldist
         return JoinNode(node.join_type, left, right, node.criteria,
-                        node.filter_expr), out_dist
+                        node.filter_expr, node.strategy,
+                        node.strategy_detail), out_dist
 
     def _v_CrossJoinNode(self, node: CrossJoinNode):
         left, ldist = self.visit(node.left)

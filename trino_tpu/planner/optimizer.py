@@ -52,6 +52,10 @@ def optimize(root: OutputNode, metadata: Metadata,
     #: rule provenance for EXPLAIN (reference: in the Java engine each
     #: PlanNode carries its source rule via PlanNodeIdAllocator tags)
     out.optimizer_trace = list(engine.trace)
+    # kernel-strategy annotation runs LAST: the choices must land on
+    # the final plan nodes the local planner and EXPLAIN read
+    out.optimizer_trace += annotate_kernel_strategies(node, metadata,
+                                                      session)
     return out
 
 
@@ -229,6 +233,152 @@ class Optimizer:
 # ---------------------------------------------------------------------------
 
 
+# ---------------------------------------------------------------------------
+# kernel-strategy cost rules: MXU matmul join + global-hash aggregation
+# ("Density-optimized ... Matrix Multiplication for Join-Project" and
+# "Global Hash Tables Strike Back!", PAPERS.md).  ONE decision path for
+# the planner annotation, the session-property overrides, and the
+# device-mesh runtime (parallel/mesh_query consults choose_agg_strategy
+# with its observed group count), so the estimate EXPLAIN shows is the
+# estimate that executed.
+
+
+def _matmul_max_build_rows() -> int:
+    """The operator's f32-exactness bound, imported lazily (the ops
+    module pulls jax; the planner stays light until a join is costed)
+    so planner estimate and runtime re-check share one definition."""
+    from ..ops.matmul_join import MAX_BUILD_ROWS
+
+    return MAX_BUILD_ROWS
+
+
+def choose_join_strategy(node: "JoinNode", calc, override: str,
+                         max_range: int) -> Tuple[str, str]:
+    """('sorted-index' | 'matmul', detail).  The matmul probe wins when
+    the build key domain maps densely onto a small one-hot width: one
+    integer-ish (or dictionary-coded) equi key whose estimated range —
+    value span for integers, pool size ≈ NDV for strings — fits
+    ``max_range``, over a confidently-small build.  Everything else
+    keeps the sorted-index probe.  The operator re-checks the ACTUAL
+    range at build time and falls back, so a forced 'MATMUL' override
+    is safe on any join."""
+    if override == "SORTED_INDEX":
+        return "sorted-index", "forced by join_strategy"
+    if override == "MATMUL":
+        return "matmul", "forced by join_strategy"
+    if node.join_type not in ("inner", "semi", "anti") \
+            or len(node.criteria) != 1:
+        return "sorted-index", ""
+    right = calc.stats(node.right)
+    if not right.confident or right.row_count > _matmul_max_build_rows():
+        return "sorted-index", ""
+    _l, r = node.criteria[0]
+    rs = right.symbol(r.name)
+    t = r.type
+    if getattr(t, "is_pooled", False):
+        # dictionary codes ARE the dense domain; pool size ~ NDV
+        if rs.distinct_count is None or rs.distinct_count > max_range:
+            return "sorted-index", ""
+        detail = (f"build~{right.row_count:.0f} rows, pool~"
+                  f"{rs.distinct_count:.0f} codes <= {max_range}")
+        return "matmul", detail
+    storage = getattr(t, "storage", None)
+    import numpy as _np
+
+    if storage is None or _np.dtype(storage).kind not in "iub":
+        return "sorted-index", ""  # float/decimal-free zone: ints only
+    if rs.low is None or rs.high is None or rs.low < 0:
+        # the equality u64 encoding is range-contiguous only for
+        # non-negative keys (no sign bias); stats-unknown ranges stay
+        # on the sorted index
+        return "sorted-index", ""
+    key_range = rs.high - rs.low + 1
+    if key_range > max_range:
+        return "sorted-index", ""
+    detail = (f"build~{right.row_count:.0f} rows, key range "
+              f"{key_range:.0f} <= {max_range}")
+    return "matmul", detail
+
+
+def choose_agg_strategy(ndv_estimate: float, n_devices: int = 1,
+                        override: str = "AUTOMATIC",
+                        max_table: Optional[int] = None
+                        ) -> Tuple[str, str]:
+    """('exchange' | 'global-hash', detail).  The global-hash table is
+    replicated per device and merged by collective scatter-add, so it
+    wins exactly when 2x the group-count bound (load factor <= 0.5)
+    stays small — below ``global_hash_agg_max_table`` slots; past that
+    the all_to_all of partial groups moves fewer bytes than the table
+    all-reduce.  Shared verbatim by the planner annotation and the
+    mesh runtime (which calls it with stage 1's OBSERVED group
+    count)."""
+    if max_table is None:
+        from .. import session_properties as SP
+
+        max_table = SP.prop_value({}, "global_hash_agg_max_table")
+    if override == "EXCHANGE":
+        return "exchange", "forced by aggregation_strategy"
+    if override == "GLOBAL_HASH":
+        return "global-hash", "forced by aggregation_strategy"
+    table = 2 * max(int(ndv_estimate), 1)
+    if table <= max_table:
+        return "global-hash", (f"~{ndv_estimate:.0f} groups -> table "
+                               f"{table} <= {max_table} over "
+                               f"{n_devices} device(s)")
+    return "exchange", (f"~{ndv_estimate:.0f} groups -> table {table} "
+                        f"> {max_table}")
+
+
+def annotate_kernel_strategies(node: PlanNode, metadata: Metadata,
+                               session=None) -> List[tuple]:
+    """Post-optimization pass: stamp every JoinNode with the probe
+    strategy and every grouped AggregationNode with the merge shape the
+    cost model picks from connector stats, honoring the session
+    overrides.  Returns (rule, detail) trace entries for EXPLAIN's
+    provenance block."""
+    from .. import session_properties as SP
+    from .stats import StatsCalculator
+
+    if session is not None:
+        join_override = SP.value(session, "join_strategy")
+        agg_override = SP.value(session, "aggregation_strategy")
+        max_range = SP.value(session, "matmul_join_max_key_range")
+        max_table = SP.value(session, "global_hash_agg_max_table")
+    else:
+        join_override = agg_override = "AUTOMATIC"
+        max_range = SP.prop_value({}, "matmul_join_max_key_range")
+        max_table = SP.prop_value({}, "global_hash_agg_max_table")
+    calc = StatsCalculator(metadata)
+    trace: List[tuple] = []
+
+    def walk(n: PlanNode):
+        for s in n.sources:
+            walk(s)
+        if isinstance(n, JoinNode):
+            strat, detail = choose_join_strategy(n, calc, join_override,
+                                                 max_range)
+            n.strategy, n.strategy_detail = strat, detail
+            if strat == "matmul":
+                trace.append(("MatmulJoinStrategy", detail))
+        elif isinstance(n, AggregationNode) and n.group_keys:
+            st = calc.stats(n)
+            if not st.confident and agg_override == "AUTOMATIC":
+                # no trustworthy group-count estimate: keep the
+                # exchange shape rather than stamping a detail derived
+                # from the DEFAULT_ROWS placeholder (the join rule
+                # gates on confidence the same way)
+                n.strategy, n.strategy_detail = "exchange", ""
+                return
+            strat, detail = choose_agg_strategy(st.row_count, 1,
+                                                agg_override, max_table)
+            n.strategy, n.strategy_detail = strat, detail
+            if strat == "global-hash":
+                trace.append(("GlobalHashAggStrategy", detail))
+
+    walk(node)
+    return trace
+
+
 def _apply(node: PlanNode, preds: Sequence[RowExpression]) -> PlanNode:
     pred = combine_conjuncts(list(preds))
     if pred is None:
@@ -248,10 +398,12 @@ def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
     if isinstance(node, AggregationNode):
         return AggregationNode(sources[0], node.group_keys,
                                node.aggregations, node.step,
-                               node.state_symbols)
+                               node.state_symbols, node.strategy,
+                               node.strategy_detail)
     if isinstance(node, JoinNode):
         return JoinNode(node.join_type, sources[0], sources[1],
-                        node.criteria, node.filter_expr)
+                        node.criteria, node.filter_expr, node.strategy,
+                        node.strategy_detail)
     if isinstance(node, CrossJoinNode):
         return CrossJoinNode(sources[0], sources[1])
     if isinstance(node, SortNode):

@@ -296,6 +296,7 @@ class LocalQueryRunner:
                f"({m['disk_spilled_bytes']} bytes)"
                if m.get("disk_spill_events") is not None else ""))
         for i, d in enumerate(plan.drivers):
+            d.collect_operator_metrics()
             lines.append(f"Pipeline {i}:")
             for st in d.stats:
                 lines.append("  " + st.line())

@@ -48,6 +48,12 @@ hot_partition_split_threshold,
 scale_writers_enabled
 rebalance_min_collectives                  parallel/distributed.py,
                                            parallel/worker.py
+join_strategy, aggregation_strategy        planner/optimizer.py
+matmul_join_max_key_range                  planner/optimizer.py,
+                                           exec/local_planner.py
+global_hash_agg_max_table                  planner/optimizer.py
+                                           (mesh runtime via
+                                           choose_agg_strategy default)
 ========================================== ===========================
 """
 
@@ -258,6 +264,16 @@ register(SessionProperty(
     "reduction ratio is trusted",
     lambda v: v >= 1))
 register(SessionProperty(
+    "adaptive_partial_aggregation_key_range_buckets", "integer",
+    _agg_default("ADAPTIVE_KEY_BUCKETS"),
+    "Per-key-range adaptive partial aggregation ('Partial Partial "
+    "Aggregates'): the hashed key space splits into this many range "
+    "buckets and the pass-through decision is made PER BUCKET, so a "
+    "skewed stream keeps aggregating its hot key ranges while cold "
+    "(mostly-unique) ranges pass through ungrouped. 1 = one global "
+    "per-stream decision (the PR 1 behavior)",
+    lambda v: 1 <= v <= 256))
+register(SessionProperty(
     "device_exchange", "boolean", True,
     "Run hash exchanges between co-resident stages as an all_to_all "
     "device collective over the mesh (falls back to the host path when "
@@ -295,6 +311,37 @@ register(SessionProperty(
     "EXPLAIN ANALYZE Trace: line). Consulted by the multi-process "
     "runner; zero-cost when off (no-op spans, nothing shipped), and "
     "spans are never opened inside jit'd code"))
+register(SessionProperty(
+    "join_strategy", "varchar", "AUTOMATIC",
+    "Join probe kernel: AUTOMATIC (cost model picks from build NDV/"
+    "range stats) | SORTED_INDEX (searchsorted binary-search probe) | "
+    "MATMUL (blocked one-hot matmul over the dense key domain — the "
+    "MXU-native low-NDV path; infeasible builds fall back per build, "
+    "reason in EXPLAIN ANALYZE)",
+    lambda v: v in ("AUTOMATIC", "SORTED_INDEX", "MATMUL"),
+    normalize=str.upper))
+register(SessionProperty(
+    "matmul_join_max_key_range", "integer", 1024,
+    "Densest key domain the matmul join strategy will one-hot encode "
+    "(per-probe-row MACs); AUTOMATIC picks matmul only when the "
+    "build key range/pool size estimate fits (the measured low-NDV "
+    "win region — BENCH_ROLE=kernels reports the crossover)",
+    lambda v: v >= 2))
+register(SessionProperty(
+    "aggregation_strategy", "varchar", "AUTOMATIC",
+    "Distributed GROUP BY merge shape: AUTOMATIC (cost model picks "
+    "from group-count estimates) | EXCHANGE (all_to_all of partial "
+    "groups + per-device merge-final) | GLOBAL_HASH (one replicated "
+    "device-resident table updated by collective scatter-add — the "
+    "low-NDV path of 'Global Hash Tables Strike Back!')",
+    lambda v: v in ("AUTOMATIC", "EXCHANGE", "GLOBAL_HASH"),
+    normalize=str.upper))
+register(SessionProperty(
+    "global_hash_agg_max_table", "integer", 16384,
+    "Largest global-hash aggregation table (slots, 2x the group-count "
+    "bound) AUTOMATIC will pick; past it the exchange+merge-final "
+    "shape moves fewer bytes than the table all-reduce",
+    lambda v: v >= 16))
 register(SessionProperty(
     "device_exchange_sizing", "varchar", "history",
     "How the device collective picks its all_to_all lane capacity "
