@@ -53,7 +53,15 @@ measured crossover NDVs, rc=9 on mismatch); BENCH_ROLE=trace / BENCH_TRACE=1
 query tracing, writes the Perfetto-loadable Chrome-trace artifact to
 BENCH_TRACE_PATH [default ./BENCH_TRACE.json], emits a
 trace_stage_overlap metric line + TRACE_RESULT, rc=7 on a
-disconnected/empty trace tree). The parent runs the qlint static
+disconnected/empty trace tree); BENCH_ROLE=qps (multi-tenant
+throughput smoke: N concurrent HTTP protocol clients, zipf tenants,
+repeat-heavy tiny/medium mix, cache-disabled vs cache-enabled phases
+reporting p50/p99 + queries/sec, QPS_RESULT line, rc=10 unless the
+cached phase shows plan-cache hits, zero retraces on a repeat
+statement, bounded _QueryState growth, and >= 1.5x the uncached QPS;
+the committed qps_speedup:<schema> baseline is ratcheted — absolute
+qps:<schema> is reported, not gated, being ~2x host-noisy). The
+parent runs the qlint static
 analyzer as a pre-flight before spawning any child (rc=8 on
 non-baselined findings: retrace-hazardous code must not burn the TPU
 budget; BENCH_SKIP_QLINT=1 skips). Every rate line carries
@@ -721,6 +729,207 @@ def _trace_smoke() -> dict:
     return out
 
 
+def _qps_smoke():
+    """BENCH_ROLE=qps: concurrent multi-tenant throughput over the REAL
+    HTTP protocol surface — N client threads POST /v1/statement and
+    follow nextUris against a ProtocolServer + LocalQueryRunner with
+    resource groups, a zipf tenant distribution, and a repeat-heavy
+    tiny/medium statement mix.  Phase A runs with the plan/result
+    caches and admission batching DISABLED (every submission re-pays
+    parse/plan/trace), phase B with them ON; both report p50/p99
+    latency and queries/sec.  The run fails (rc=10) unless phase B
+    shows plan-cache hits, a repeat statement performs ZERO jit traces,
+    the _QueryState table stays bounded, and QPS reaches
+    BENCH_QPS_MIN_SPEEDUP (default 1.5) x the uncached phase.  The
+    cached-baseline ratchet gates on the committed SPEEDUP
+    (qps_speedup:<schema> — self-normalizing; absolute qps:<schema>
+    rides the metric line as reported context, since wall-clock QPS on
+    a shared host swings ~2x between identical runs).
+    Env: BENCH_QPS_SCHEMA (micro|tiny, default tiny), BENCH_QPS_CLIENTS
+    (default 8), BENCH_QPS_QUERIES (per client, default 25),
+    BENCH_QPS_TENANTS (default 12), BENCH_QPS_RATCHET_MIN (default
+    0.6, applied to the speedup ratio)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/trino_tpu_jax_cache")
+    import numpy as np
+
+    from trino_tpu import jit_stats
+    from trino_tpu.client import Client
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.resource_groups import ResourceGroupManager
+    from trino_tpu.runner import LocalQueryRunner
+    from trino_tpu.server.protocol import ProtocolServer
+    from trino_tpu.sql.analyzer import Session
+
+    schema = os.environ.get("BENCH_QPS_SCHEMA", "tiny")
+    n_clients = int(os.environ.get("BENCH_QPS_CLIENTS", "8"))
+    per_client = int(os.environ.get("BENCH_QPS_QUERIES", "25"))
+    n_tenants = int(os.environ.get("BENCH_QPS_TENANTS", "12"))
+    min_speedup = float(os.environ.get("BENCH_QPS_MIN_SPEEDUP", "1.5"))
+
+    rg = ResourceGroupManager.from_config({"groups": [
+        {"name": "tenants", "user": "tenant-.*", "max_concurrency": 8,
+         "max_queued": 10_000},
+        {"name": "global", "max_concurrency": 8, "max_queued": 10_000},
+    ]})
+    runner = LocalQueryRunner({"tpch": TpchConnector()},
+                              Session(catalog="tpch", schema=schema),
+                              resource_groups=rg)
+    srv = ProtocolServer(runner).start()
+    t_start = time.time()
+
+    tiny_templates = [
+        "select count(*) c, sum(o_totalprice) s from orders "
+        "where o_custkey % 64 = {t}",
+        "select count(*) c, sum(l_quantity) q from lineitem "
+        "where l_partkey % 128 = {t}",
+    ]
+    medium_templates = [
+        "select l_returnflag, l_linestatus, count(*) c, "
+        "sum(l_quantity) q from lineitem "
+        "group by l_returnflag, l_linestatus",
+        "select o_orderpriority, count(*) c from orders "
+        "group by o_orderpriority",
+    ]
+
+    def workload(seed: int):
+        """Deterministic per-client statement list: zipf-distributed
+        tenants (hot tenants dominate — the dashboard pattern), 80%
+        tiny parameterized point-ish queries, 20% medium aggregations."""
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(per_client):
+            t = int(rng.zipf(1.5)) % n_tenants
+            if rng.random() < 0.8:
+                tpl = tiny_templates[int(rng.integers(len(tiny_templates)))]
+                out.append((f"tenant-{t}", tpl.format(t=t)))
+            else:
+                m = medium_templates[int(rng.integers(
+                    len(medium_templates)))]
+                out.append((f"tenant-{t}", m))
+        return out
+
+    admin = Client(srv.uri)
+
+    def set_knobs(on: bool):
+        v = "true" if on else "false"
+        for name in ("plan_cache_enabled", "result_cache_enabled",
+                     "admission_batching_enabled"):
+            admin.execute(f"set session {name} = {v}")
+
+    def run_phase(label: str, caches_on: bool) -> dict:
+        set_knobs(caches_on)
+        lat = [[] for _ in range(n_clients)]
+        errors = []
+
+        def worker(ci: int):
+            cl = Client(srv.uri)
+            for user, sql in workload(1000 + ci):
+                cl.user = user
+                t0 = time.perf_counter()
+                try:
+                    cl.execute(sql)
+                except Exception as e:  # counted, not fatal per query
+                    errors.append(repr(e))
+                    continue
+                lat[ci].append(time.perf_counter() - t0)
+
+        t0 = time.time()
+        threads = [threading.Thread(target=worker, args=(ci,))
+                   for ci in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.time() - t0
+        all_lat = sorted(x for chunk in lat for x in chunk)
+        n = len(all_lat)
+        return {
+            "label": label, "queries": n, "errors": len(errors),
+            "wall_s": round(wall, 2),
+            "qps": round(n / wall, 2) if wall > 0 else 0.0,
+            "p50_ms": round(all_lat[n // 2] * 1e3, 1) if n else 0.0,
+            "p99_ms": round(all_lat[min(n - 1, int(n * 0.99))] * 1e3, 1)
+            if n else 0.0,
+        }
+
+    off = run_phase("uncached", caches_on=False)
+    on = run_phase("cached", caches_on=True)
+    counters = runner.query_cache.counters()
+
+    # zero-retrace probe: a repeat statement through the warm plan/
+    # processor caches must not trace anything (result cache off so the
+    # probe actually EXECUTES the pipeline)
+    admin.execute("set session result_cache_enabled = false")
+    probe_user, probe_sql = workload(1000)[0]
+    admin.user = probe_user
+    admin.execute(probe_sql)          # re-key under the final session fp
+    before = jit_stats.total()
+    admin.execute(probe_sql)
+    probe_traces = jit_stats.total() - before
+
+    # bounded _QueryState growth: all delivered results must have been
+    # popped; nothing may accumulate with sustained submissions
+    states_left = len(srv.queries)
+
+    speedup = round(on["qps"] / off["qps"], 2) if off["qps"] else 0.0
+    cache = _load_cache()
+    base = cache.get(f"qps:{schema}")
+    ratio = round(on["qps"] / base, 3) if base else 0.0
+    # the RATCHET gates on the speedup (cached/uncached within ONE run
+    # — self-normalizing, both phases share the host's load), not on
+    # absolute QPS: wall-clock throughput on a shared host swings ~2x
+    # between identical runs, which would make an absolute ratchet cry
+    # wolf.  Absolute QPS still rides the metric line as vs_baseline.
+    speed_base = cache.get(f"qps_speedup:{schema}")
+    speed_ratio = round(speedup / speed_base, 3) if speed_base else 0.0
+    floor = float(os.environ.get("BENCH_QPS_RATCHET_MIN", "0.6"))
+    regressed = bool(speed_base) and speed_ratio < floor
+    ok = (on["queries"] == off["queries"] == n_clients * per_client
+          and on["errors"] == 0 and off["errors"] == 0
+          and counters["plan_hits"] > 0
+          and probe_traces == 0
+          and states_left <= 2 * n_clients
+          and speedup >= min_speedup
+          and not regressed)
+    out = {
+        "ok": ok, "schema": schema, "clients": n_clients,
+        "uncached": off, "cached": on, "speedup": speedup,
+        "plan_cache": {k: v for k, v in counters.items()
+                       if k.startswith("plan")},
+        "result_cache": {k: v for k, v in counters.items()
+                         if k.startswith("result")},
+        "batching": {k: counters[k] for k in
+                     ("batches", "batched_queries", "coalesced")},
+        "probe_traces": probe_traces,
+        "query_states_left": states_left,
+        "wall_s": round(time.time() - t_start, 2),
+    }
+    print(json.dumps({
+        "metric": f"qps_{schema}_queries_per_sec", "value": on["qps"],
+        "unit": "qps", "vs_baseline": ratio,
+        "p50_ms": on["p50_ms"], "p99_ms": on["p99_ms"],
+        "clients": n_clients,
+    }), flush=True)
+    print(json.dumps({
+        "metric": f"qps_{schema}_speedup_vs_uncached", "value": speedup,
+        "unit": "x", "vs_baseline": speed_ratio,
+        "uncached_qps": off["qps"], "uncached_p99_ms": off["p99_ms"],
+    }), flush=True)
+    if regressed:
+        print(json.dumps({
+            "metric": f"qps_{schema}_speedup_regressed",
+            "value": speed_ratio, "unit": "x_vs_baseline",
+            "vs_baseline": speed_ratio,
+        }), flush=True)
+    print("QPS_RESULT " + json.dumps(out), flush=True)
+    srv.stop()
+    if not ok:
+        raise SystemExit(10)
+    return out
+
+
 # ---------------------------------------------------------------- parent ----
 
 def _guarded_child_cls():
@@ -1037,5 +1246,7 @@ if __name__ == "__main__":
         _kernels_smoke()
     elif os.environ.get("BENCH_ROLE") == "trace":
         _trace_smoke()
+    elif os.environ.get("BENCH_ROLE") == "qps":
+        _qps_smoke()
     else:
         main()

@@ -204,6 +204,37 @@ def test_bench_kernels_smoke_child():
 
 
 @pytest.mark.slow
+def test_bench_qps_smoke_child():
+    """The bench harness's multi-tenant throughput role (BENCH_ROLE=
+    qps): 8 concurrent HTTP protocol clients over a zipf tenant mix
+    must report p50/p99 + queries/sec for a cache-disabled and a
+    cache-enabled phase, with plan-cache hits, ZERO retraces on the
+    repeat probe, bounded _QueryState growth, and >= 1.5x QPS from the
+    caches — run as the real child process so the whole admission-to-
+    execution path cannot rot outside the test suite."""
+    env = dict(os.environ, BENCH_ROLE="qps", JAX_PLATFORMS="cpu",
+               BENCH_QPS_SCHEMA="micro", BENCH_QPS_QUERIES="12",
+               BENCH_QPS_TENANTS="6", BENCH_QPS_RATCHET_MIN="0.4")
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [line for line in proc.stdout.splitlines()
+             if line.startswith("QPS_RESULT ")]
+    assert len(lines) == 1, proc.stdout[-2000:]
+    out = json.loads(lines[0][len("QPS_RESULT "):])
+    assert out["ok"] is True
+    assert out["clients"] == 8
+    assert out["cached"]["queries"] == out["uncached"]["queries"] == 96
+    assert out["cached"]["p99_ms"] > 0 and out["cached"]["qps"] > 0
+    assert out["speedup"] >= 1.5
+    assert out["plan_cache"]["plan_hits"] > 0
+    assert out["probe_traces"] == 0
+    assert out["query_states_left"] <= 16
+    assert out["batching"]["batches"] >= 1
+
+
+@pytest.mark.slow
 def test_bench_measure_child_micro_cpu():
     env = dict(os.environ, BENCH_ROLE="measure", BENCH_PLATFORM="cpu",
                BENCH_SCHEMA="micro", BENCH_QUERIES="q1,q18",

@@ -1,0 +1,446 @@
+"""Plan/result caching + admission batching (round 13).
+
+The invalidation matrix is the heart: identical re-submission must HIT
+(and perform zero jit traces — the compiled-pipeline reuse is the whole
+point), while DDL on a referenced table, a connector version bump
+(write), and a differing session fingerprint must all MISS and
+recompute correct answers.  Batched execution must be byte-equal to
+serial, the result cache must stay inside its memory-governance
+budget, and every counter must be scrapeable through the PR 6 metrics
+surface (SQL over system.runtime.metrics included)."""
+
+import threading
+import time
+
+import pytest
+
+from trino_tpu import jit_stats
+from trino_tpu.cache import (QueryCache, ResultCache, is_deterministic,
+                             normalize_statement, statement_catalogs)
+from trino_tpu.connectors.memory import MemoryConnector
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.sql import ast
+from trino_tpu.sql.analyzer import Session
+from trino_tpu.sql.parser import parse_statement
+
+
+def _mem_runner(**kwargs):
+    return LocalQueryRunner({"memory": MemoryConnector()},
+                            Session(catalog="memory", schema="default"),
+                            **kwargs)
+
+
+@pytest.fixture(scope="module")
+def runner():
+    r = _mem_runner()
+    r.execute("create table t (k bigint, v bigint)")
+    r.execute("insert into t values (1, 10), (2, 20), (3, 30)")
+    return r
+
+
+# ---------------------------------------------------------------------------
+# shape normalization
+
+
+def test_normalize_parameterizes_literals():
+    a = parse_statement("select v from t where k = 5 and v > 1.5")
+    b = parse_statement("select v from t where k = 9 and v > 2.5")
+    c = parse_statement("select v from t where k = 5 or v > 1.5")
+    sa, la = normalize_statement(a)
+    sb, lb = normalize_statement(b)
+    sc, _ = normalize_statement(c)
+    assert sa == sb                       # literals out -> same shape
+    assert la != lb                       # the vectors differ
+    assert la == (("long", 5), ("decimal", "1.5"))
+    assert sa != sc                       # AND vs OR is structural
+    assert hash(sa) == hash(sb)           # usable as a dict key
+
+
+def test_normalize_keeps_type_distinctions():
+    a = parse_statement("select * from t where k = 5")
+    b = parse_statement("select * from t where k = 5.0")
+    _, la = normalize_statement(a)
+    _, lb = normalize_statement(b)
+    # 5 types as bigint, 5.0 as decimal(2,1): the kind tag keeps their
+    # plans (and result-cache entries) apart
+    assert la[0][0] == "long" and lb[0][0] == "decimal"
+
+
+def test_statement_catalogs_resolution():
+    session = Session(catalog="memory", schema="default")
+    one = parse_statement("select * from t")
+    two = parse_statement(
+        "select * from tpch.tiny.orders o join t on o.o_orderkey = t.k")
+    with_q = parse_statement(
+        "with w as (select 1 x) select * from w")
+    assert statement_catalogs(one, session) == {"memory"}
+    assert statement_catalogs(two, session) == {"tpch", "memory"}
+    # a WITH alias over-approximates to the session catalog — extra
+    # versions only cost misses, never staleness
+    assert statement_catalogs(with_q, session) == {"memory"}
+
+
+def test_is_deterministic():
+    assert is_deterministic(parse_statement("select k from t"))
+    assert not is_deterministic(parse_statement("select random()"))
+
+
+# ---------------------------------------------------------------------------
+# plan cache: hit path + invalidation matrix
+
+
+def test_repeat_query_hits_plan_cache_with_zero_traces(runner):
+    sql = "select sum(v) s from t where k >= 2"
+    first = runner.execute(sql)
+    assert first.rows == [(50,)]
+    hits0 = runner.query_cache.plans.hits
+    before = jit_stats.total()
+    again = runner.execute(sql)
+    assert again.rows == first.rows
+    assert again.stats.get("plan_cache") == "hit"
+    assert runner.query_cache.plans.hits == hits0 + 1
+    # the compiled-pipeline reuse claim, machine-checked: a repeat
+    # statement must not trace ANY kernel
+    assert jit_stats.total() == before
+
+
+def test_write_invalidates_plan_cache(runner):
+    sql = "select sum(v) s from t where k >= 0"
+    assert runner.execute(sql).rows == [(60,)]
+    inv0 = runner.query_cache.plans.invalidations
+    runner.execute("insert into t values (4, 40)")
+    res = runner.execute(sql)
+    assert res.rows == [(100,)]           # recomputed, not stale
+    assert res.stats.get("plan_cache") != "hit"
+    assert runner.query_cache.plans.invalidations > inv0
+    runner.execute("delete from t where k = 4")
+    assert runner.execute(sql).rows == [(60,)]
+
+
+def test_ddl_on_referenced_table_invalidates(runner):
+    runner.execute("create table d (x bigint)")
+    runner.execute("insert into d values (7)")
+    sql = "select count(*) c from d"
+    assert runner.execute(sql).rows == [(1,)]
+    assert runner.execute(sql).stats.get("plan_cache") == "hit"
+    runner.execute("drop table d")
+    runner.execute("create table d (x bigint)")
+    res = runner.execute(sql)
+    assert res.stats.get("plan_cache") != "hit"
+    assert res.rows == [(0,)]             # the NEW (empty) table
+
+
+def test_session_fingerprint_differs(runner):
+    sql = "select max(v) m from t"
+    runner.execute(sql)
+    assert runner.execute(sql).stats.get("plan_cache") == "hit"
+    runner.execute("set session desired_splits = 3")
+    try:
+        res = runner.execute(sql)
+        assert res.stats.get("plan_cache") != "hit"   # fp moved -> miss
+        assert res.rows == [(30,)]
+        assert runner.execute(sql).stats.get("plan_cache") == "hit"
+    finally:
+        runner.session.properties.pop("desired_splits", None)
+
+
+def test_plan_cache_disabled_by_property():
+    r = _mem_runner()
+    r.execute("create table t (k bigint)")
+    r.execute("insert into t values (1)")
+    r.execute("set session plan_cache_enabled = false")
+    sql = "select count(*) c from t"
+    r.execute(sql)
+    res = r.execute(sql)
+    assert res.stats.get("plan_cache") is None
+    assert r.query_cache.plans.hits == 0
+
+
+def test_system_catalog_uncacheable(runner):
+    sql = "select count(*) c from system.runtime.metrics"
+    runner.execute(sql)
+    res = runner.execute(sql)
+    # live catalog: no snapshot version -> never cached
+    assert res.stats.get("plan_cache") is None
+    assert runner.query_cache.cache_key(
+        runner.query_cache.parse(sql, runner.session),
+        runner.session) is None
+
+
+# ---------------------------------------------------------------------------
+# result cache
+
+
+def test_result_cache_hit_and_write_invalidation(runner):
+    runner.execute("set session result_cache_enabled = true")
+    try:
+        sql = "select sum(v) s from t where k <= 2"
+        a = runner.execute(sql)
+        b = runner.execute(sql)
+        assert b.stats.get("result_cache") == "hit"
+        assert b.rows == a.rows == [(30,)]
+        # rows returned on a hit are a fresh list: caller mutation must
+        # not corrupt the cached copy
+        b.rows.append(("junk",))
+        assert runner.execute(sql).rows == [(30,)]
+        runner.execute("insert into t values (0, 5)")
+        c = runner.execute(sql)
+        assert c.stats.get("result_cache") != "hit"
+        assert c.rows == [(35,)]
+        runner.execute("delete from t where k = 0")
+    finally:
+        runner.session.properties.pop("result_cache_enabled", None)
+
+
+def test_result_cache_memory_bounded():
+    rc = ResultCache(max_bytes=8_192)
+    rows = [(i, "x" * 40) for i in range(40)]
+    for i in range(12):
+        rc.store(("shape", i), ["a", "b"], None, list(rows))
+    assert rc.evictions > 0
+    assert rc.reserved_bytes <= 8_192
+    # oversized single entry is skipped, not force-fitted
+    rc.store(("big",), ["a"], None, [("y" * 200,)] * 400)
+    assert rc.lookup(("big",)) is None
+    assert rc.reserved_bytes <= 8_192
+
+
+# ---------------------------------------------------------------------------
+# admission batching
+
+
+def test_execute_batch_byte_equal_and_coalesced(runner):
+    sqls = ["select sum(v) s from t where k >= 1",
+            "select sum(v) s from t where k >= 2",
+            "select sum(v) s from t where k >= 1",   # identical: coalesces
+            "select count(*) c from t"]              # shape diverges
+    serial = [runner.execute(s) for s in sqls]
+    co0 = runner.query_cache.coalesced
+    batch = runner.execute_batch(sqls)
+    assert [r.rows for r in batch] == [r.rows for r in serial]
+    assert runner.query_cache.coalesced == co0 + 1
+
+
+def test_execute_batch_failure_is_per_statement(runner):
+    out = runner.execute_batch(["select sum(v) s from t",
+                                "select no_such_column from t"])
+    assert out[0].rows == [(60,)]
+    assert isinstance(out[1], Exception)
+
+
+def test_protocol_batch_formation_and_fallback():
+    """Deterministic batch shaping: a backlog of same-shape statements
+    drains as ONE batch under one admission slot; a divergent shape is
+    left for its own drain (the byte-equal serial fallback)."""
+    from trino_tpu.resource_groups import ResourceGroupManager
+    from trino_tpu.server.protocol import ProtocolServer, _QueryState
+
+    rg = ResourceGroupManager.from_config(
+        {"groups": [{"name": "global", "max_concurrency": 4}]})
+    r = _mem_runner(resource_groups=rg)
+    r.execute("create table t (k bigint, v bigint)")
+    r.execute("insert into t values (1, 10), (2, 20)")
+    srv = ProtocolServer(r)   # not started: drive internals directly
+    admitted0 = rg.roots[0].total_admitted   # setup DDL admitted too
+    try:
+        texts = ["select sum(v) s from t where k >= 1",
+                 "select sum(v) s from t where k >= 2",
+                 "select count(*) c from t"]
+        states = []
+        for i, sql in enumerate(texts):
+            q = _QueryState(f"q{i}", sql)
+            q.shape = r.query_cache.parse(sql, r.session).shape
+            states.append(q)
+            srv._backlog.append(q)
+        srv._drain_batch()
+        # first two share a shape -> one batch; the third stayed queued
+        assert states[0].state == "FINISHED"
+        assert states[1].state == "FINISHED"
+        assert states[2].state == "QUEUED"
+        srv._drain_batch()
+        assert states[2].state == "FINISHED"
+        assert states[0].result.rows == [(30,)]
+        assert states[1].result.rows == [(20,)]
+        assert states[2].result.rows == [(2,)]
+        # 2 admission slots covered 3 queries: the batch amortization
+        assert rg.roots[0].total_admitted - admitted0 == 2
+    finally:
+        srv.stop()
+
+
+def test_protocol_user_header_routes_resource_group():
+    from trino_tpu.client import Client
+    from trino_tpu.resource_groups import ResourceGroupManager
+    from trino_tpu.server.protocol import ProtocolServer
+
+    rg = ResourceGroupManager.from_config({"groups": [
+        {"name": "tenants", "user": "tenant-.*", "max_concurrency": 4},
+        {"name": "global", "max_concurrency": 4}]})
+    r = _mem_runner(resource_groups=rg)
+    r.execute("create table t (k bigint)")
+    r.execute("insert into t values (1)")
+    srv = ProtocolServer(r).start()
+    try:
+        res = Client(srv.uri, user="tenant-3").execute(
+            "select count(*) c from t")
+        assert res.rows == [[1]]
+        tenants = {name: adm for name, adm, _, _
+                   in rg.counter_stats()}
+        # the tenant header routed admission to the tenants group (the
+        # setup DDL ran as the session user through "global")
+        assert tenants["tenants"] == 1
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# protocol eviction + metrics surface
+
+
+def test_protocol_eviction_timer_is_deterministic():
+    """An abandoned (never-polled) finished query must evict on the
+    TIMER — no further traffic required — so _QueryState cannot grow
+    unbounded under sustained load."""
+    import json
+    import urllib.request
+
+    from trino_tpu.server.protocol import ProtocolServer
+
+    r = _mem_runner()
+    srv = ProtocolServer(r, query_ttl=0.6, evict_interval=0.15).start()
+    try:
+        req = urllib.request.Request(srv.uri + "/v1/statement",
+                                     data=b"select 1", method="POST")
+        doc = json.loads(urllib.request.urlopen(req).read())
+        deadline = time.time() + 10
+        while doc["id"] in srv.queries and time.time() < deadline:
+            time.sleep(0.1)   # no polls, no submissions: timer only
+        assert doc["id"] not in srv.queries
+        assert len(srv.queries) == 0
+    finally:
+        srv.stop()
+
+
+def test_cache_counters_via_metrics_and_sql(runner):
+    fams = {f["name"] for f in runner.metrics_families()}
+    assert "trino_plan_cache_total" in fams
+    assert "trino_result_cache_total" in fams
+    assert "trino_processor_cache_total" in fams
+    assert "trino_admission_batches_total" in fams
+    rows = runner.execute(
+        "select name, value from system.runtime.metrics "
+        "where name like 'trino_plan_cache%'").rows
+    assert rows, "cache counters must be queryable via SQL"
+    total = {n for n, _v in rows}
+    assert "trino_plan_cache_total" in total
+
+
+def test_resource_group_counters_exported():
+    from trino_tpu.resource_groups import ResourceGroupManager
+
+    rg = ResourceGroupManager.from_config(
+        {"groups": [{"name": "global", "max_concurrency": 2}]})
+    r = _mem_runner(resource_groups=rg)
+    r.execute("create table t (k bigint)")
+    r.execute("select count(*) from t")
+    fams = {f["name"]: f for f in r.metrics_families()}
+    assert "trino_resource_group_admissions_total" in fams
+    assert "trino_resource_group_queue_peak" in fams
+    samples = fams["trino_resource_group_admissions_total"]["samples"]
+    admitted = [v for lbl, v in samples
+                if lbl.get("kind") == "admitted"]
+    assert admitted and admitted[0] >= 1
+
+
+def test_session_properties_registered():
+    from trino_tpu import session_properties as SP
+
+    for name, type_ in (("plan_cache_enabled", "boolean"),
+                        ("plan_cache_entries", "integer"),
+                        ("result_cache_enabled", "boolean"),
+                        ("admission_batching_enabled", "boolean"),
+                        ("admission_batch_max", "integer")):
+        prop = SP.REGISTRY[name]
+        assert prop.type == type_
+    props = {}
+    SP.set_property(props, "admission_batch_max", "8")
+    assert props["admission_batch_max"] == 8
+    with pytest.raises(Exception):
+        SP.set_property(props, "admission_batch_max", "1")
+
+
+def test_plan_cache_lru_bound(runner):
+    runner.execute("set session plan_cache_entries = 4")
+    try:
+        for i in range(8):
+            runner.execute(f"select sum(v) s from t where k > {i}")
+        assert len(runner.query_cache.plans) <= 4
+        assert runner.query_cache.plans.evictions >= 4
+    finally:
+        runner.session.properties.pop("plan_cache_entries", None)
+
+
+def test_result_cache_is_user_scoped_and_rechecks_acl():
+    """Cached rows must never cross a tenant ACL: the key is
+    user-scoped AND every hit re-enforces SELECT, so a denied user can
+    neither hit another user's entry nor keep reading after a
+    revocation."""
+    from trino_tpu.security import (AccessDeniedError,
+                                    RuleBasedAccessControl, TableRule)
+
+    acl = RuleBasedAccessControl([
+        TableRule(user="alice", privileges=["SELECT", "INSERT",
+                                            "OWNERSHIP"]),
+        TableRule(user="trino", privileges=["SELECT", "INSERT",
+                                            "OWNERSHIP"]),
+    ])
+    r = LocalQueryRunner({"memory": MemoryConnector()},
+                         Session(catalog="memory", schema="default"),
+                         access_control=acl)
+    r.execute("create table t (k bigint)")
+    r.execute("insert into t values (1)")
+    r.execute("set session result_cache_enabled = true")
+    sql = "select count(*) c from t"
+    assert r.execute(sql, user="alice").rows == [(1,)]
+    assert r.execute(sql, user="alice").stats.get(
+        "result_cache") == "hit"
+    # bob shares the statement text but not the ACL: user-scoped key
+    # -> no hit, and the execution path denies at the table check
+    with pytest.raises(AccessDeniedError):
+        r.execute(sql, user="bob")
+    # revocation takes effect on the next HIT, not at the next miss
+    acl.rules = [rule for rule in acl.rules if rule.user != "alice"]
+    with pytest.raises(AccessDeniedError):
+        r.execute(sql, user="alice")
+
+
+def test_execute_batch_never_coalesces_writes(runner):
+    """Identical INSERT texts in one batch must each run: coalescing is
+    reserved for deterministic plain queries."""
+    runner.execute("create table w (k bigint)")
+    try:
+        out = runner.execute_batch(["insert into w values (1)",
+                                    "insert into w values (1)"])
+        assert not isinstance(out[0], Exception)
+        assert not isinstance(out[1], Exception)
+        assert runner.execute("select count(*) c from w").rows == [(2,)]
+    finally:
+        runner.execute("drop table w")
+
+
+def test_concurrent_repeat_queries_share_processors(runner):
+    """Concurrent executions of cached plans share PageProcessor
+    instances — the lock added for sharing must not corrupt results."""
+    sql = "select sum(v) s from t where k >= 1"
+    expect = runner.execute(sql).rows
+    out = [None] * 6
+
+    def go(i):
+        out[i] = runner.execute(sql).rows
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(r == expect for r in out)

@@ -48,6 +48,14 @@ def null_pool_value(t) -> object:
                   or getattr(t, "is_row", False)) else ""
 
 
+#: process-unique Dictionary ids for host-side caches.  ``id()`` is NOT
+#: a safe cache key across pool lifetimes: once PageProcessors outlive
+#:  a query (the round-13 shared-processor cache), a freed pool's
+#: address can be reused by a new same-length pool and a stale LUT
+#: would silently apply to the wrong values — ``uid`` never aliases.
+_dict_uids = __import__("itertools").count(1)
+
+
 class Dictionary:
     """Host-side string pool. Identity (``id()``) defines code compatibility:
     two blocks share code semantics iff they share the Dictionary object.
@@ -57,7 +65,7 @@ class Dictionary:
     object because device kernels only ever see codes.
     """
 
-    __slots__ = ("values", "_index", "_sort_rank", "_lock")
+    __slots__ = ("values", "_index", "_sort_rank", "_lock", "uid")
 
     def __init__(self, values: Sequence[str] = ()):
         import threading
@@ -66,6 +74,7 @@ class Dictionary:
         self._index = {v: i for i, v in enumerate(self.values)}
         self._sort_rank = None
         self._lock = threading.Lock()
+        self.uid = next(_dict_uids)
 
     @classmethod
     def aligned(cls, values: Sequence[str]) -> "Dictionary":
@@ -81,6 +90,7 @@ class Dictionary:
             d._index.setdefault(v, i)
         d._sort_rank = None
         d._lock = threading.Lock()
+        d.uid = next(_dict_uids)
         return d
 
     def __len__(self) -> int:

@@ -31,13 +31,18 @@ class Client:
     """``Client("http://host:port").execute("select 1")``"""
 
     def __init__(self, server: str, poll_interval: float = 0.05,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0, user: Optional[str] = None):
         self.server = server.rstrip("/")
         self.poll_interval = poll_interval
         self.timeout = timeout
+        #: tenant identity for resource-group routing + admission
+        #: batching (reference: the X-Trino-User request header)
+        self.user = user
 
     def _http(self, method: str, url: str, body: Optional[bytes] = None):
-        req = urllib.request.Request(url, data=body, method=method)
+        headers = {"X-Trino-User": self.user} if self.user else {}
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=headers)
         with urllib.request.urlopen(req, timeout=60) as resp:
             return json.loads(resp.read().decode())
 

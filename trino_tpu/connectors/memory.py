@@ -97,11 +97,13 @@ class MemoryMetadata(ConnectorMetadata):
                                  "TABLE_ALREADY_EXISTS")
             self.conn.tables[(schema, table)] = _TableData(list(columns))
             self.conn.schemas.add(schema)
+            self.conn._version += 1      # DDL invalidates cached plans
         return TableHandle(self.conn.catalog_name, schema, table)
 
     def drop_table(self, table: TableHandle):
         with self.conn.lock:
             self.conn.tables.pop((table.schema, table.table), None)
+            self.conn._version += 1      # DDL invalidates cached plans
 
 
 class MemorySplitManager(ConnectorSplitManager):
@@ -118,15 +120,19 @@ class MemorySplitManager(ConnectorSplitManager):
 
 
 class MemoryPageSink(ConnectorPageSink):
-    def __init__(self, data: _TableData):
+    def __init__(self, data: _TableData, conn: "MemoryConnector"):
         self.data = data
         self.rows = 0
+        self.conn = conn
 
     def append_page(self, page: Page):
         page = self.data.canonicalize(page)
         with self.data.lock:
             self.data.pages.append(page)
             self.rows += page.num_rows
+        # bump per page, not only at finish: a cached read overlapping a
+        # half-complete write must already see a moved snapshot version
+        self.conn.bump_version()
 
     def finish(self) -> dict:
         return {"rows": self.rows}
@@ -141,6 +147,16 @@ class MemoryConnector(Connector):
         self.schemas = set(schemas)
         self.tables: Dict[Tuple[str, str], _TableData] = {}
         self.lock = threading.Lock()
+        self._version = 0
+
+    def data_version(self) -> int:
+        """Snapshot version for the plan/result caches: every DDL and
+        every written page bumps it, so dependent cache entries miss."""
+        return self._version
+
+    def bump_version(self):
+        with self.lock:
+            self._version += 1
 
     def metadata(self) -> ConnectorMetadata:
         return MemoryMetadata(self)
@@ -167,4 +183,5 @@ class MemoryConnector(Connector):
 
     def page_sink(self, table: TableHandle,
                   columns: Sequence[ColumnHandle]) -> ConnectorPageSink:
-        return MemoryPageSink(self.tables[(table.schema, table.table)])
+        return MemoryPageSink(self.tables[(table.schema, table.table)],
+                              self)

@@ -243,6 +243,16 @@ class Connector:
 
     name = "base"
 
+    def data_version(self) -> Optional[int]:
+        """Monotonic snapshot version of this catalog's data+metadata,
+        or None when the connector cannot promise stability (live
+        catalogs like ``system``).  The plan/result caches key on it:
+        any DDL or write MUST move the version, and a None makes every
+        statement touching the catalog uncacheable (reference analog:
+        the connector ``getTableHandle`` snapshot id materialized-view
+        staleness checks key on)."""
+        return None
+
     def metadata(self) -> ConnectorMetadata:
         raise NotImplementedError
 

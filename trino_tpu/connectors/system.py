@@ -82,6 +82,12 @@ class SystemConnector(Connector):
 
     name = "system"
 
+    def data_version(self) -> None:
+        """Live catalog — every read reflects CURRENT runner state, so
+        statements touching it are uncacheable (inherits the base None;
+        spelled out because the plan/result caches depend on it)."""
+        return None
+
     def __init__(self, catalog_name: str = "system", source=None,
                  history_limit: int = 200):
         self.catalog_name = catalog_name

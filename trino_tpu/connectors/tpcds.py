@@ -1285,6 +1285,9 @@ class TpcdsSplitManager(ConnectorSplitManager):
 class TpcdsConnector(Connector):
     name = "tpcds"
 
+    def data_version(self) -> int:
+        return 0    # deterministic generator: data never changes
+
     def __init__(self, catalog_name: str = "tpcds",
                  page_rows: int = 65536):
         self.catalog_name = catalog_name

@@ -666,6 +666,9 @@ class TpchSplitManager(ConnectorSplitManager):
 class TpchConnector(Connector):
     name = "tpch"
 
+    def data_version(self) -> int:
+        return 0    # deterministic generator: data never changes
+
     def __init__(self, catalog_name: str = "tpch", page_rows: int = 65536):
         self.catalog_name = catalog_name
         self.page_rows = page_rows

@@ -120,7 +120,8 @@ class LocalExecutionPlanner:
                  adaptive_partial_ratio: float = ADAPTIVE_RATIO_THRESHOLD,
                  adaptive_partial_min_rows: int = ADAPTIVE_MIN_ROWS,
                  adaptive_partial_buckets: int = ADAPTIVE_KEY_BUCKETS,
-                 matmul_max_key_range: int = 1024):
+                 matmul_max_key_range: int = 1024,
+                 processor_cache=None):
         self.metadata = metadata
         self.desired_splits = desired_splits
         self.task_id = task_id
@@ -147,10 +148,26 @@ class LocalExecutionPlanner:
         #: — the multi-process runtime routes worker writes to the
         #: coordinator's catalog through this (page-sink RPC)
         self.page_sink_factory = page_sink_factory
+        #: shared compiled-PageProcessor cache (cache.ProcessorCache):
+        #: repeat plans land on already-traced jit programs instead of
+        #: re-tracing every expression per submission; None = build
+        #: fresh per plan (the pre-cache behavior)
+        self.processor_cache = processor_cache
         self.pipelines: List[PhysicalPipeline] = []
         # scan-node id -> [(channel, DynamicFilter)] attachments
         self._scan_dfs: Dict[int, List] = {}
         self.dynamic_filters: List = []  # all filters, for query stats
+
+    def _processor(self, input_types, projections,
+                   filter_expr=None) -> PageProcessor:
+        """Every PageProcessor this planner builds comes through here so
+        the shared-processor cache can intercept: the IR is frozen
+        dataclasses, so (types, projections, filter) IS the program."""
+        if self.processor_cache is not None:
+            return self.processor_cache.get(input_types, projections,
+                                            filter_expr)
+        return PageProcessor(list(input_types), list(projections),
+                             filter_expr)
 
     def _mem_ctx(self, name: str):
         if self.memory_pool is None:
@@ -173,7 +190,7 @@ class LocalExecutionPlanner:
         if [p.channel for p in projections] != list(range(len(types_))) or \
                 len(projections) != len(types_):
             ops.append(FilterProjectOperator(
-                PageProcessor(types_, projections)))
+                self._processor(types_, projections)))
         sink = OutputCollectorOperator()
         ops.append(sink)
         self.pipelines.append(PhysicalPipeline(ops))
@@ -232,13 +249,14 @@ class LocalExecutionPlanner:
         pred = to_input_refs(node.predicate, layout)
         projections = [InputRef(t, i) for i, t in enumerate(types_)]
         ops.append(FilterProjectOperator(
-            PageProcessor(types_, projections, pred)))
+            self._processor(types_, projections, pred)))
         return ops, layout, types_
 
     def _v_ProjectNode(self, node: ProjectNode):
         ops, layout, types_ = self.visit(node.source)
         projections = [to_input_refs(e, layout) for _, e in node.assignments]
-        ops.append(FilterProjectOperator(PageProcessor(types_, projections)))
+        ops.append(FilterProjectOperator(
+            self._processor(types_, projections)))
         new_layout = {s.name: i for i, (s, _) in enumerate(node.assignments)}
         return ops, new_layout, [s.type for s, _ in node.assignments]
 
@@ -290,11 +308,11 @@ class LocalExecutionPlanner:
         const_key = not criteria
         if const_key:
             # append literal-0 key channel to both sides
-            bops.append(FilterProjectOperator(PageProcessor(
+            bops.append(FilterProjectOperator(self._processor(
                 btypes, [InputRef(t, i) for i, t in enumerate(btypes)]
                 + [Literal(T.BIGINT, 0)])))
             btypes = btypes + [T.BIGINT]
-            pops.append(FilterProjectOperator(PageProcessor(
+            pops.append(FilterProjectOperator(self._processor(
                 ptypes, [InputRef(t, i) for i, t in enumerate(ptypes)]
                 + [Literal(T.BIGINT, 0)])))
             ptypes = ptypes + [T.BIGINT]
@@ -324,7 +342,7 @@ class LocalExecutionPlanner:
                 combined_layout[name] = len(ptypes) + ch
             combined_types = ptypes + btypes
             pred = to_input_refs(filter_expr, combined_layout)
-            proc = PageProcessor(
+            proc = self._processor(
                 combined_types,
                 [InputRef(t, i) for i, t in enumerate(combined_types)],
                 pred)
@@ -383,7 +401,7 @@ class LocalExecutionPlanner:
             if want != list(range(len(want))) or len(want) != len(types_):
                 proj = [InputRef(types_[c], c) for c in want]
                 ops.append(FilterProjectOperator(
-                    PageProcessor(types_, proj)))
+                    self._processor(types_, proj)))
                 types_ = [types_[c] for c in want]
                 layout = {s.name: i for i, s in enumerate(in_syms)}
                 group_channels = list(range(len(node.group_keys)))
@@ -458,7 +476,7 @@ class LocalExecutionPlanner:
                            for s, cs in zip(node.symbols,
                                             child.output_symbols)]
             cops.append(FilterProjectOperator(
-                PageProcessor(ctypes, projections)))
+                self._processor(ctypes, projections)))
             sink = OutputCollectorOperator()
             cops.append(sink)
             self.pipelines.append(PhysicalPipeline(cops))

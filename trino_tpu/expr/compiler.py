@@ -80,9 +80,17 @@ class PageProcessor:
     def __init__(self, input_types: List[T.Type],
                  projections: List[RowExpression],
                  filter_expr: Optional[RowExpression] = None):
+        import threading
+
         self.input_types = list(input_types)
         self.projections = list(projections)
         self.filter_expr = filter_expr
+        # instances are SHARED across concurrent queries when built
+        # through cache.ProcessorCache (the per-instance jax.jit is the
+        # whole point — repeat statements must not retrace); the lock
+        # serializes the host-side LUT/dictionary caches only, never
+        # the jitted compute
+        self._cache_lock = threading.Lock()
         self.slots: List[_Slot] = []
         self._slot_of: Dict[int, int] = {}   # id(plan-node) -> slot index
         self._lut_cache: Dict = {}
@@ -442,8 +450,8 @@ class PageProcessor:
 
         def results(dicts):
             # ONE host pass shared by both slots (value + None mask)
-            key = (id(dicts[view.channel]),
-                   len(dicts[view.channel] or ())) \
+            d0 = dicts[view.channel] if view.channel is not None else None
+            key = (d0.uid if d0 is not None else 0, len(d0 or ())) \
                 if view.channel is not None else ("lit",)
             hit = memo.get(key)
             if hit is None:
@@ -628,7 +636,8 @@ class PageProcessor:
 
         def merged_dict(dicts) -> Dictionary:
             key = (token,) + tuple(
-                (id(dicts[c]), len(dicts[c]) if dicts[c] is not None
+                (dicts[c].uid if dicts[c] is not None else 0,
+                 len(dicts[c]) if dicts[c] is not None
                  else 0) for c in key_channels)
             d = self._dict_cache.get(key)
             if d is None:
@@ -796,21 +805,27 @@ class PageProcessor:
     # runtime
 
     def _fill_luts(self, dicts) -> Tuple:
-        luts = []
-        for i, slot in enumerate(self.slots):
-            key = (i, tuple(id(d) for d in dicts if d is not None),
-                   tuple(len(d) for d in dicts if d is not None))
-            arr = self._lut_cache.get(key)
-            if arr is None:
-                raw = slot.fill(dicts)
-                cap = padded_size(max(len(raw), 1), minimum=8)
-                arr = np.zeros(cap, dtype=raw.dtype)
-                arr[:len(raw)] = raw
-                self._lut_cache[key] = arr
-                if len(self._lut_cache) > 256:
-                    self._lut_cache.clear()
-            luts.append(jnp.asarray(arr))
-        return tuple(luts)
+        # keys use Dictionary.uid, never id(): shared processors outlive
+        # queries, and a freed pool's ADDRESS can be reused by a new
+        # same-length pool — uid cannot alias
+        arrs = []
+        with self._cache_lock:
+            for i, slot in enumerate(self.slots):
+                key = (i, tuple(d.uid for d in dicts if d is not None),
+                       tuple(len(d) for d in dicts if d is not None))
+                arr = self._lut_cache.get(key)
+                if arr is None:
+                    raw = slot.fill(dicts)
+                    cap = padded_size(max(len(raw), 1), minimum=8)
+                    arr = np.zeros(cap, dtype=raw.dtype)
+                    arr[:len(raw)] = raw
+                    self._lut_cache[key] = arr
+                    if len(self._lut_cache) > 256:
+                        self._lut_cache.clear()
+                arrs.append(arr)
+        # host->device uploads OUTSIDE the lock: concurrent queries
+        # sharing this processor must serialize only the cache lookups
+        return tuple(jnp.asarray(a) for a in arrs)
 
     def _run(self, cols, nulls, valid, luts):
         from .. import jit_stats
@@ -836,6 +851,15 @@ class PageProcessor:
         luts = self._fill_luts(dicts)
         cols, nulls, valid = self._jit(
             tuple(dpage.cols), tuple(dpage.nulls), dpage.valid, luts)
+        with self._cache_lock:
+            out_dicts = self._resolve_out_dicts(dicts)
+        return DevicePage(self.output_types, list(cols), list(nulls), valid,
+                          out_dicts)
+
+    def _resolve_out_dicts(self, dicts) -> List[Optional[Dictionary]]:
+        """Output dictionary per projection (caller holds _cache_lock:
+        pool identity must be stable across pages AND across the
+        concurrent queries sharing this processor)."""
         out_dicts = []
         for j, proj in enumerate(self.projections):
             if _is_pooled(proj.type):
@@ -858,7 +882,7 @@ class PageProcessor:
                     out_dicts.append(dicts[view.channel])
                 else:
                     base = dicts[view.channel]
-                    key = (j, id(base), len(base))
+                    key = (j, base.uid, len(base))
                     d = self._dict_cache.get(key)
                     if d is None:
                         from ..block import null_pool_value as _npv_fn
@@ -873,8 +897,7 @@ class PageProcessor:
                     out_dicts.append(d)
             else:
                 out_dicts.append(None)
-        return DevicePage(self.output_types, list(cols), list(nulls), valid,
-                          out_dicts)
+        return out_dicts
 
 
 # ---------------------------------------------------------------------------

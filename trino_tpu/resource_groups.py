@@ -54,6 +54,11 @@ class ResourceGroup:
         self.running = 0
         self.queued = 0
         self.memory_reserved = 0    # sum of admitted queries' budgets
+        # cumulative admission counters (metrics registry /
+        # system.runtime.metrics — the qps harness's observables)
+        self.total_admitted = 0
+        self.total_queued_waits = 0
+        self.queue_peak = 0
         # ONE condition per tree: a release in any subgroup may free
         # shared ancestor capacity a SIBLING's waiter is blocked on, and
         # ancestor counters must mutate under one lock
@@ -104,6 +109,8 @@ class ResourceGroup:
                 if self.queued >= self.spec.max_queued:
                     raise QueryQueueFullError(self.name)
                 self.queued += 1
+                self.total_queued_waits += 1
+                self.queue_peak = max(self.queue_peak, self.queued)
                 try:
                     ok = self._cond.wait_for(
                         lambda: self._can_run_locked(memory_bytes),
@@ -115,6 +122,7 @@ class ResourceGroup:
             for g in self._chain():
                 g.running += 1
                 g.memory_reserved += memory_bytes
+            self.total_admitted += 1
 
     def release(self, memory_bytes: int = 0):
         with self._cond:
@@ -170,6 +178,21 @@ class ResourceGroupManager:
             for g in groups:
                 out.append((g.name, g.running, g.queued,
                             g.memory_reserved))
+                walk(g.subgroups)
+
+        walk(self.roots)
+        return out
+
+    def counter_stats(self) -> List[tuple]:
+        """Cumulative ``(name, admitted, queued_waits, queue_peak)`` per
+        group, depth-first — the counter companion of ``stats()``
+        (which snapshots live depths)."""
+        out: List[tuple] = []
+
+        def walk(groups: List[ResourceGroup]):
+            for g in groups:
+                out.append((g.name, g.total_admitted,
+                            g.total_queued_waits, g.queue_peak))
                 walk(g.subgroups)
 
         walk(self.roots)

@@ -43,7 +43,8 @@ class LocalQueryRunner:
                  desired_splits: int = 4,
                  access_control=None,
                  event_listeners: Optional[Sequence] = None,
-                 resource_groups=None):
+                 resource_groups=None,
+                 result_cache_bytes: int = 64 << 20):
         from .events import EventListenerManager
         from .security import ALLOW_ALL
 
@@ -63,23 +64,44 @@ class LocalQueryRunner:
         self.event_manager = EventListenerManager(
             list(event_listeners or ()))
         self.resource_groups = resource_groups
+        # plan + result + shared-processor caches (cache.py): repeat
+        # statements skip parse/plan and land on already-traced jit
+        # programs; gated per query by plan_cache_enabled /
+        # result_cache_enabled
+        from .cache import QueryCache
 
-    def _check_table_access(self, stmt: ast.Statement, root: OutputNode):
-        """Enforce SELECT on every scanned table with its column set
-        (reference: AccessControlManager.checkCanSelectFromColumns at
-        analysis time)."""
+        self.query_cache = QueryCache(
+            self.metadata, result_cache_bytes=result_cache_bytes)
+
+    def _scan_refs(self, root: OutputNode) -> List[tuple]:
+        """Every scanned ``(catalog, schema, table, columns)`` of a plan
+        — the access-check unit, also stored beside cached results so a
+        cache hit re-enforces SELECT for the requesting user."""
         from .planner.plan import TableScanNode
+
+        out: List[tuple] = []
 
         def walk(node):
             if isinstance(node, TableScanNode):
-                self.access_control.check_can_select(
-                    self.session.user, node.catalog, node.table.schema,
-                    node.table.table,
-                    [col.name for _, col in node.assignments])
+                out.append((node.catalog, node.table.schema,
+                            node.table.table,
+                            [col.name for _, col in node.assignments]))
             for s in node.sources:
                 walk(s)
 
         walk(root)
+        return out
+
+    def _check_table_access(self, stmt: ast.Statement, root: OutputNode,
+                            user: Optional[str] = None):
+        """Enforce SELECT on every scanned table with its column set
+        (reference: AccessControlManager.checkCanSelectFromColumns at
+        analysis time).  ``user`` is the effective tenant (protocol
+        header), defaulting to the session user."""
+        user = user or self.session.user
+        for catalog, schema, table, cols in self._scan_refs(root):
+            self.access_control.check_can_select(user, catalog, schema,
+                                                 table, cols)
 
     # ------------------------------------------------------------------
 
@@ -104,33 +126,93 @@ class LocalQueryRunner:
         prov = provenance_lines(root)
         return text + ("\n" + "\n".join(prov) if prov else "")
 
-    def execute(self, sql: str) -> QueryResult:
+    def execute(self, sql: str, user: Optional[str] = None
+                ) -> QueryResult:
         """Admission (resource group) + access control + event firing
         around one statement (reference: DispatchManager.createQuery's
-        admission path + QueryMonitor)."""
+        admission path + QueryMonitor).  ``user`` overrides the session
+        user for admission routing (multi-tenant protocol serving)."""
+        user = user or self.session.user
+        self.access_control.check_can_execute_query(user)
+        if self.resource_groups is not None:
+            from . import session_properties as SP
+
+            group = self.resource_groups.select(user)
+            # memory-aware admission: the query's budget is its
+            # charge against the group's soft/hard memory limits
+            with group.run(memory_bytes=SP.value(
+                    self.session, "query_max_memory_bytes")):
+                return self._monitored_execute(sql, user)
+        return self._monitored_execute(sql, user)
+
+    def execute_batch(self, sqls: Sequence[str],
+                      user: Optional[str] = None) -> List:
+        """Admission batching: ONE resource-group slot covers a burst of
+        (typically same-shape) statements — the dispatcher-side
+        amortization for high-QPS tenants.  Identical texts coalesce to
+        a single execution whose result demuxes to every submitter;
+        distinct texts execute serially inside the slot through the
+        plan/processor caches, so results are byte-equal to the serial
+        path by construction.  Returns one QueryResult OR Exception per
+        statement, positionally — a failure fails only its own
+        statement, not the batch."""
+        user = user or self.session.user
+        self.access_control.check_can_execute_query(user)
+
+        def coalescable(sql: str) -> bool:
+            # only deterministic plain queries may demux one execution
+            # to several submitters: repeat INSERTs must run per
+            # statement, and random()-class calls must diverge exactly
+            # as they would serially
+            try:
+                pq = self.query_cache.parse(sql, self.session)
+            except Exception:
+                return False
+            return pq.is_query and pq.deterministic
+
+        def run_all() -> List:
+            out: List = []
+            memo: Dict[str, object] = {}
+            coalesced = 0
+            for sql in sqls:
+                if sql in memo:
+                    coalesced += 1
+                    out.append(memo[sql])
+                    continue
+                try:
+                    res = self._monitored_execute(sql, user)
+                except Exception as e:  # demuxed per statement
+                    out.append(e)
+                    if coalescable(sql):
+                        memo[sql] = e
+                else:
+                    out.append(res)
+                    if coalescable(sql):
+                        memo[sql] = res
+            self.query_cache.note_batch(len(out), coalesced)
+            return out
+
+        if self.resource_groups is not None:
+            from . import session_properties as SP
+
+            group = self.resource_groups.select(user)
+            with group.run(memory_bytes=SP.value(
+                    self.session, "query_max_memory_bytes")):
+                return run_all()
+        return run_all()
+
+    def _monitored_execute(self, sql: str, user: str) -> QueryResult:
         import time as _time
 
         from .events import QueryMonitor
 
-        self.access_control.check_can_execute_query(self.session.user)
-        monitor = QueryMonitor(self.event_manager, self.session.user,
-                               sql) if self.event_manager.listeners \
-            else None
+        monitor = QueryMonitor(self.event_manager, user, sql) \
+            if self.event_manager.listeners else None
         t0 = _time.perf_counter()
         if monitor:
             monitor.created()
         try:
-            if self.resource_groups is not None:
-                from . import session_properties as SP
-
-                group = self.resource_groups.select(self.session.user)
-                # memory-aware admission: the query's budget is its
-                # charge against the group's soft/hard memory limits
-                with group.run(memory_bytes=SP.value(
-                        self.session, "query_max_memory_bytes")):
-                    res = self._execute_sql(sql)
-            else:
-                res = self._execute_sql(sql)
+            res = self._execute_sql(sql, user=user)
         except Exception as e:
             if monitor:
                 monitor.failed(e)
@@ -146,8 +228,14 @@ class LocalQueryRunner:
             })
         return res
 
-    def _execute_sql(self, sql: str) -> QueryResult:
-        stmt = parse_statement(sql)
+    def _execute_sql(self, sql: str,
+                     user: Optional[str] = None) -> QueryResult:
+        # memoized parse + shape analysis: repeat statement texts skip
+        # the parser entirely (the cache also feeds the admission
+        # batcher's shape grouping)
+        user = user or self.session.user
+        pq = self.query_cache.parse(sql, self.session)
+        stmt = pq.stmt
         if isinstance(stmt, ast.Explain):
             if stmt.analyze:
                 return self._explain_analyze(stmt.statement)
@@ -218,10 +306,58 @@ class LocalQueryRunner:
             catalog, _, schema, table = self.metadata.resolve_target(
                 stmt.table, self.session)
             self.access_control.check_can_insert(
-                self.session.user, catalog, schema, table)
-        root = self.plan_statement(stmt)
-        self._check_table_access(stmt, root)
-        local = self._make_local_planner()
+                user, catalog, schema, table)
+        return self._execute_query(pq, stmt, user)
+
+    def _execute_query(self, pq, stmt: ast.Statement,
+                       user: str) -> QueryResult:
+        """The cached hot path.  Lookup order: result cache (rows, WITH
+        literals) -> plan cache (optimized root, skips analyze/plan/
+        optimize) -> full planning.  Either cache key embeds the
+        session fingerprint and the referenced connectors' snapshot
+        versions, so SET SESSION and DDL/writes invalidate loudly (the
+        key moves) instead of silently serving stale plans.  Operator
+        shells are re-instantiated per execution — splits, memory
+        pools, and dynamic filters stay per-query — but the compiled
+        PageProcessors come from the shared cache: a repeat statement
+        performs ZERO jit traces."""
+        from . import session_properties as SP
+
+        plan_caching = SP.value(self.session, "plan_cache_enabled")
+        # the effective user is part of the key: tenants must never
+        # share entries (a per-user ACL would otherwise leak rows)
+        key = self.query_cache.cache_key(pq, self.session, user=user) \
+            if plan_caching else None
+        result_caching = key is not None and pq.deterministic and \
+            SP.value(self.session, "result_cache_enabled")
+        if result_caching:
+            hit = self.query_cache.results.lookup(key)
+            if hit is not None:
+                names, types_, rows, _nb, scans = hit
+                # SELECT is re-enforced on EVERY hit (defense in depth
+                # beside the user-scoped key): an ACL revocation must
+                # take effect immediately, cached rows or not
+                for catalog, schema, table, cols in scans:
+                    self.access_control.check_can_select(
+                        user, catalog, schema, table, cols)
+                # fresh list per hit: a caller sorting rows in place
+                # must not corrupt the cached copy
+                return QueryResult(list(names), list(types_),
+                                   list(rows),
+                                   stats={"result_cache": "hit"})
+        root = self.query_cache.plans.lookup(key) \
+            if key is not None else None
+        plan_hit = root is not None
+        if root is None:
+            root = self.plan_statement(stmt)
+            if key is not None:
+                self.query_cache.plans.store(
+                    key, root,
+                    SP.value(self.session, "plan_cache_entries"))
+        self._check_table_access(stmt, root, user)  # on EVERY run
+        local = self._make_local_planner(
+            processor_cache=self.query_cache.processors
+            if plan_caching else None)
         try:
             plan = local.plan(root)
             pages = plan.execute()
@@ -236,8 +372,20 @@ class LocalQueryRunner:
         if local.dynamic_filters:
             stats["dynamic_filters"] = [df.stats()
                                         for df in local.dynamic_filters]
-        return QueryResult(plan.column_names, plan.output_types, rows,
-                           stats=stats)
+        if plan_hit:
+            stats["plan_cache"] = "hit"
+        res = QueryResult(plan.column_names, plan.output_types, rows,
+                          stats=stats)
+        if result_caching:
+            # re-derive the key AFTER execution: a write that landed
+            # mid-query moved the snapshot version, and a torn read
+            # must not freeze into the cache
+            if self.query_cache.cache_key(pq, self.session,
+                                          user=user) == key:
+                self.query_cache.results.store(
+                    key, res.column_names, res.types, list(rows),
+                    scans=self._scan_refs(root))
+        return res
 
     def _splits(self) -> int:
         from . import session_properties as SP
@@ -251,7 +399,8 @@ class LocalQueryRunner:
 
         return SP.value(self.session, "join_max_expand_lanes")
 
-    def _make_local_planner(self) -> LocalExecutionPlanner:
+    def _make_local_planner(self, processor_cache=None
+                            ) -> LocalExecutionPlanner:
         """Session-configured planner: ALL execution paths (execute,
         EXPLAIN ANALYZE, the DELETE rewrite) must honor the same
         session knobs."""
@@ -265,6 +414,7 @@ class LocalQueryRunner:
             dynamic_filtering=SP.value(self.session,
                                        "enable_dynamic_filtering"),
             scan_coalesce=SP.value(self.session, "scan_coalesce_enabled"),
+            processor_cache=processor_cache,
             **grouping_options(self.session.properties))
 
     def _explain_analyze(self, stmt: ast.Statement) -> QueryResult:
@@ -332,6 +482,19 @@ class LocalQueryRunner:
                 g.set(running, group=name, kind="running")
                 g.set(queued, group=name, kind="queued")
                 m.set(mem, group=name)
+            adm = reg.counter(
+                "trino_resource_group_admissions_total",
+                "Cumulative admission counters per resource group "
+                "(kind=admitted|queued_waits); queue_peak gauges the "
+                "deepest queue observed")
+            pk = reg.gauge("trino_resource_group_queue_peak",
+                           "Deepest admission queue observed per group")
+            for name, admitted, waits, peak in \
+                    self.resource_groups.counter_stats():
+                adm.inc(admitted, group=name, kind="admitted")
+                adm.inc(waits, group=name, kind="queued_waits")
+                pk.set(peak, group=name)
+        self.query_cache.add_families(reg)
         return process_families() + reg.collect()
 
     def _connector(self, catalog: Optional[str]) -> Connector:
@@ -395,6 +558,7 @@ class LocalQueryRunner:
         if stmt.where is None:
             with data.lock:
                 data.pages = []
+            conn.bump_version()   # cached plans/results over t are stale
             return QueryResult(["rows"], [T.BIGINT], [(before,)])
         keep = ast.NotExpression(ast.FunctionCall(
             "coalesce", (stmt.where, ast.BooleanLiteral(False))))
@@ -407,6 +571,7 @@ class LocalQueryRunner:
         res_pages = [data.canonicalize(p) for p in plan.execute()]
         with data.lock:
             data.pages = res_pages
+        conn.bump_version()       # cached plans/results over t are stale
         return QueryResult(["rows"], [T.BIGINT],
                            [(before - sum(p.num_rows
                                           for p in res_pages),)])

@@ -11,7 +11,19 @@ Implementation: stdlib ThreadingHTTPServer over any engine runner
 share the execute() surface).  Queries run on a small executor;
 results page out ``page_size`` rows per GET with token-sequenced
 nextUris; abandoned queries (no poll within ``query_ttl``) are evicted
-so disconnected clients cannot pin materialized results.
+on a background timer so disconnected clients cannot pin materialized
+results (and ``_QueryState`` stays bounded under sustained load).
+
+Admission batching (round 13): when the runner supports
+``execute_batch`` and ``admission_batching_enabled`` is on, submitted
+query statements enter a backlog keyed by their normalized shape
+(``cache.QueryCache.parse``); an executor drain pops one head plus
+every same-(shape, user) statement queued behind it — a burst of
+repeat dashboard statements rides ONE resource-group admission slot,
+identical texts coalesce to a single execution, and any divergent
+shape falls back to its own drain (serial, byte-equal).  The tenant
+arrives via the ``X-Trino-User`` header (reference: the dispatcher's
+session context resolution).
 """
 
 from __future__ import annotations
@@ -43,11 +55,14 @@ def _json_value(v, type_: T.Type):
 
 
 class _QueryState:
-    def __init__(self, qid: str, sql: str = ""):
+    def __init__(self, qid: str, sql: str = "",
+                 user: Optional[str] = None):
         import time
 
         self.id = qid
         self.sql = sql
+        self.user = user
+        self.shape = None         # normalized-AST shape (batch grouping)
         self.state = "QUEUED"
         self.error: Optional[dict] = None
         self.result = None
@@ -71,13 +86,29 @@ class ProtocolServer:
 
     def __init__(self, runner, host: str = "127.0.0.1", port: int = 0,
                  page_size: int = 1000, query_ttl: float = 3600.0,
-                 history_size: int = 100):
+                 history_size: int = 100,
+                 evict_interval: Optional[float] = None):
+        import collections
+
         from ..telemetry.metrics import MetricsRegistry
 
         self.runner = runner
         self.page_size = page_size
         self.query_ttl = query_ttl
+        #: abandoned-query sweep cadence: a TIMER, not per-submit — at
+        #: high QPS an O(n) scan per submission is overhead, and with
+        #: no traffic at all an abandoned _QueryState must still evict
+        #: (deterministic bounded memory under sustained load)
+        self.evict_interval = evict_interval if evict_interval \
+            is not None else max(1.0, min(query_ttl / 4, 30.0))
+        self._stop_evictor = threading.Event()
         self.queries: Dict[str, _QueryState] = {}
+        #: admission-batching backlog: submitted statements waiting for
+        #: an executor worker; a drain pops one head and takes every
+        #: same-(shape, user) statement queued behind it, up to
+        #: admission_batch_max, into ONE resource-group slot
+        self._backlog = collections.deque()
+        self._backlog_lock = threading.Lock()
         #: finished-query info retained for GET /v1/query/{id}
         #: (bounded ring: oldest evicted first -> 404); the lock keeps
         #: concurrent executor threads from double-popping the same
@@ -89,6 +120,15 @@ class ProtocolServer:
         self._http_queries = self.registry.counter(
             "trino_http_statements_total",
             "Statements submitted over /v1/statement, by outcome")
+        self._batches = self.registry.counter(
+            "trino_http_admission_batches_total",
+            "Admission batches drained by size bucket "
+            "(size=1 means no burst was waiting)")
+        self.registry.gauge_fn(
+            "trino_http_query_states",
+            "Live _QueryState entries (submitted, not yet delivered "
+            "or evicted) — bounded under sustained load",
+            lambda: len(self.queries))
         self.executor = ThreadPoolExecutor(max_workers=4)
         outer = self
 
@@ -121,7 +161,10 @@ class ProtocolServer:
                     return
                 n = int(self.headers.get("Content-Length", 0))
                 sql = self.rfile.read(n).decode()
-                self._reply(200, outer.submit(sql))
+                # reference: X-Trino-User identifies the tenant for
+                # resource-group routing + admission batching
+                user = self.headers.get("X-Trino-User")
+                self._reply(200, outer.submit(sql, user=user))
 
             def do_GET(self):
                 parts = self.path.strip("/").split("/")
@@ -175,55 +218,153 @@ class ProtocolServer:
         self._thread = threading.Thread(target=self.httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        threading.Thread(target=self._evict_loop, daemon=True).start()
         return self
 
     def stop(self):
-        self.httpd.shutdown()
+        self._stop_evictor.set()
+        if self._thread is not None:   # shutdown() hangs if never served
+            self.httpd.shutdown()
+        self.httpd.server_close()
         self.executor.shutdown(wait=False)
 
     # ------------------------------------------------------------------
 
+    def _evict_loop(self):
+        """Background sweep: eviction must happen on a CLOCK, not only
+        when fresh traffic arrives — a burst of abandoned clients
+        followed by silence must still drain to zero _QueryStates."""
+        while not self._stop_evictor.wait(self.evict_interval):
+            self._evict_abandoned()
+
     def _evict_abandoned(self):
         """Drop finished queries no client polled within query_ttl —
-        abandoned clients must not pin materialized results forever."""
+        abandoned clients must not pin materialized results forever.
+        Non-terminal states get a 10x grace: a client's poll can stall
+        behind a long compile, and reaping a RUNNING query under load
+        would fail healthy waiters (the timer sweep made that a real
+        hazard the old traffic-driven sweep only hid)."""
         import time
 
         now = time.time()
         for qid, q in list(self.queries.items()):
-            if now - q.last_poll > self.query_ttl:
+            idle = now - q.last_poll
+            if idle > self.query_ttl and \
+                    (q.state in ("FINISHED", "FAILED")
+                     or idle > 10 * self.query_ttl):
                 self.queries.pop(qid, None)
 
-    def submit(self, sql: str) -> dict:
-        self._evict_abandoned()
+    def _batching_enabled(self) -> bool:
+        from .. import session_properties as SP
+
+        session = getattr(self.runner, "session", None)
+        return session is not None \
+            and hasattr(self.runner, "execute_batch") \
+            and SP.value(session, "admission_batching_enabled")
+
+    def submit(self, sql: str, user: Optional[str] = None) -> dict:
         qid = uuid.uuid4().hex[:16]
-        q = _QueryState(qid, sql)
+        q = _QueryState(qid, sql, user=user)
         self.queries[qid] = q
-
-        def run():
-            import time
-
-            q.state = "RUNNING"
-            t0 = time.perf_counter()
+        if self._batching_enabled():
+            # shape analysis is the memoized parse the execution reuses
+            # — a burst of repeat texts pays it once, ever
             try:
-                q.result = self.runner.execute(sql)
-                q.state = "FINISHED"
-                self._http_queries.inc(state="FINISHED")
-            except Exception as e:
-                q.error = {
-                    "message": str(e),
-                    "errorCode": getattr(e, "code", "GENERIC_INTERNAL_ERROR"),
-                    "errorType": type(e).__name__,
-                }
-                q.state = "FAILED"
-                self._http_queries.inc(state="FAILED")
-            self._record_finished(q, (time.perf_counter() - t0) * 1e3)
-
-        self.executor.submit(run)
+                pq = self.runner.query_cache.parse(sql,
+                                                   self.runner.session)
+                if pq.is_query:
+                    q.shape = pq.shape
+            except Exception:
+                q.shape = None  # unparseable: fails on the solo path
+        if q.shape is not None:
+            with self._backlog_lock:
+                self._backlog.append(q)
+            self.executor.submit(self._drain_batch)
+        else:
+            self.executor.submit(self._run_single, q)
         return {
             "id": qid,
             "nextUri": f"{self.uri}/v1/statement/executing/{qid}/0",
             "stats": {"state": q.state},
         }
+
+    def _run_single(self, q: _QueryState):
+        import time
+
+        q.state = "RUNNING"
+        t0 = time.perf_counter()
+        try:
+            # per-tenant admission routing needs the user-aware execute
+            # (LocalQueryRunner); other runners keep their session user
+            if q.user is not None and hasattr(self.runner,
+                                              "execute_batch"):
+                q.result = self.runner.execute(q.sql, user=q.user)
+            else:
+                q.result = self.runner.execute(q.sql)
+            q.state = "FINISHED"
+            self._http_queries.inc(state="FINISHED")
+        except Exception as e:
+            self._fail(q, e)
+        self._record_finished(q, (time.perf_counter() - t0) * 1e3)
+
+    def _fail(self, q: _QueryState, e: Exception):
+        q.error = {
+            "message": str(e),
+            "errorCode": getattr(e, "code", "GENERIC_INTERNAL_ERROR"),
+            "errorType": type(e).__name__,
+        }
+        q.state = "FAILED"
+        self._http_queries.inc(state="FAILED")
+
+    def _take_batch(self) -> List[_QueryState]:
+        """Pop the backlog head plus every same-(shape, user) statement
+        queued behind it, up to admission_batch_max; statements whose
+        shape diverges stay queued in order for their own drain (each
+        submission scheduled one)."""
+        from .. import session_properties as SP
+
+        limit = SP.value(self.runner.session, "admission_batch_max")
+        with self._backlog_lock:
+            if not self._backlog:
+                return []
+            head = self._backlog.popleft()
+            batch = [head]
+            rest = []
+            while self._backlog and len(batch) < limit:
+                cand = self._backlog.popleft()
+                if cand.shape == head.shape and cand.user == head.user:
+                    batch.append(cand)
+                else:
+                    rest.append(cand)
+            self._backlog.extendleft(reversed(rest))
+            return batch
+
+    def _drain_batch(self):
+        import time
+
+        batch = self._take_batch()
+        if not batch:
+            return  # a sibling drain absorbed this submission's work
+        self._batches.inc(size=min(len(batch), 16))
+        for q in batch:
+            q.state = "RUNNING"
+        t0 = time.perf_counter()
+        try:
+            results = self.runner.execute_batch(
+                [q.sql for q in batch], user=batch[0].user)
+        except Exception as e:
+            # admission-level failure (queue full, rejected budget):
+            # fails the whole burst — each statement reports it
+            results = [e] * len(batch)
+        wall_ms = (time.perf_counter() - t0) * 1e3
+        for q, res in zip(batch, results):
+            if isinstance(res, Exception):
+                self._fail(q, res)
+            else:
+                q.result = res
+                q.state = "FINISHED"
+                self._http_queries.inc(state="FINISHED")
+            self._record_finished(q, wall_ms)
 
     def _record_finished(self, q: _QueryState, wall_ms: float):
         """Retain the finished query's stats tree for GET /v1/query/{id}

@@ -54,6 +54,10 @@ matmul_join_max_key_range                  planner/optimizer.py,
 global_hash_agg_max_table                  planner/optimizer.py
                                            (mesh runtime via
                                            choose_agg_strategy default)
+plan_cache_enabled, plan_cache_entries,    runner.py
+result_cache_enabled
+admission_batching_enabled,                server/protocol.py
+admission_batch_max
 ========================================== ===========================
 """
 
@@ -342,6 +346,39 @@ register(SessionProperty(
     "bound) AUTOMATIC will pick; past it the exchange+merge-final "
     "shape moves fewer bytes than the table all-reduce",
     lambda v: v >= 16))
+register(SessionProperty(
+    "plan_cache_enabled", "boolean", True,
+    "Cache analysis->plan->optimize output per normalized statement "
+    "shape (+ literal vector + session fingerprint + connector "
+    "snapshot versions) AND share the compiled PageProcessors, so a "
+    "repeat statement skips parse/plan entirely and performs zero jit "
+    "traces (the prepared-statement analog of the _exchange_program "
+    "lru_cache). Invalidation is structural: DDL/writes bump the "
+    "connector snapshot version and SET SESSION moves the fingerprint, "
+    "so stale entries can never be served"))
+register(SessionProperty(
+    "plan_cache_entries", "integer", 256,
+    "LRU bound on resident plan-cache entries (one entry per "
+    "shape x literal-vector x fingerprint combination)",
+    lambda v: v >= 1))
+register(SessionProperty(
+    "result_cache_enabled", "boolean", False,
+    "Serve repeat deterministic SELECTs straight from cached rows, "
+    "keyed WITH literals and invalidated by connector snapshot "
+    "versions; cached pages charge a dedicated QueryMemoryPool and "
+    "evict LRU over budget. Off by default: repeated dashboards opt "
+    "in (statements over unversioned/live catalogs never cache)"))
+register(SessionProperty(
+    "admission_batching_enabled", "boolean", True,
+    "Dispatcher-side admission batching: a burst of same-shape "
+    "statements queued for one resource group executes under ONE "
+    "admission slot (identical texts coalesce to a single execution, "
+    "demuxed per submitter); shapes that diverge fall back to plain "
+    "serial dispatch, byte-equal by construction"))
+register(SessionProperty(
+    "admission_batch_max", "integer", 16,
+    "Largest statement burst one admission slot may absorb",
+    lambda v: v >= 2))
 register(SessionProperty(
     "device_exchange_sizing", "varchar", "history",
     "How the device collective picks its all_to_all lane capacity "
