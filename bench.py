@@ -53,7 +53,17 @@ measured crossover NDVs, rc=9 on mismatch); BENCH_ROLE=trace / BENCH_TRACE=1
 query tracing, writes the Perfetto-loadable Chrome-trace artifact to
 BENCH_TRACE_PATH [default ./BENCH_TRACE.json], emits a
 trace_stage_overlap metric line + TRACE_RESULT, rc=7 on a
-disconnected/empty trace tree); BENCH_ROLE=qps (multi-tenant
+disconnected/empty trace tree; ALSO the flight recorder: the run
+executes with query_profiling_enabled so every process records
+per-program trace/compile wall + XLA cost analysis, the merged
+cluster table writes to BENCH_PROFILE_PATH [default
+./BENCH_PROFILE.json], a differ vs the committed artifact names any
+kernel that moved [profile_moved metric line], the total
+compile-seconds ratchet gates against profile_compile_s:trace x
+BENCH_PROFILE_COMPILE_FACTOR [default 2.0], and rc=11 flags an
+empty/disconnected profile or a compile-budget breach — distinct
+from rc=7 so trace-tree and profile failures triage separately);
+BENCH_ROLE=qps (multi-tenant
 throughput smoke: N concurrent HTTP protocol clients, zipf tenants,
 repeat-heavy tiny/medium mix, cache-disabled vs cache-enabled phases
 reporting p50/p99 + queries/sec, QPS_RESULT line, rc=10 unless the
@@ -662,6 +672,8 @@ def _trace_smoke() -> dict:
     from trino_tpu.telemetry.tracing import (span_tree, stage_overlap,
                                              to_chrome_trace)
 
+    from trino_tpu.telemetry import profiler as profiler_mod
+
     sql = ("select c.c_custkey, o.o_orderkey from customer c "
            "join orders o on c.c_custkey = o.o_custkey "
            "where c.c_mktsegment = 'BUILDING' "
@@ -671,7 +683,11 @@ def _trace_smoke() -> dict:
     t0 = time.time()
     with ProcessQueryRunner(
             {"tpch": {"connector": "tpch", "page_rows": 4096}},
-            Session(catalog="tpch", schema="micro"),
+            # the flight recorder: profiling ON end to end, so every
+            # process (coordinator + workers) records per-program
+            # trace/compile wall + XLA cost analysis as it compiles
+            Session(catalog="tpch", schema="micro",
+                    properties={"query_profiling_enabled": True}),
             n_workers=2, desired_splits=4,
             broadcast_threshold=300.0) as c:
         res = c.execute(sql)
@@ -682,6 +698,7 @@ def _trace_smoke() -> dict:
         t_q3 = time.time()
         c.execute(TPCH_QUERIES[3])
         q3_wall = round(time.time() - t_q3, 3)
+        profile = c.profile_snapshot()
     spans = (res.stats or {}).get("trace") or []
     roots, _children, orphans = span_tree(spans)
     artifact = os.environ.get("BENCH_TRACE_PATH",
@@ -699,6 +716,47 @@ def _trace_smoke() -> dict:
     ratio = round(overlap / base, 3) if base else 0.0
     floor = float(os.environ.get("BENCH_TRACE_RATCHET_MIN", "0.8"))
     regressed = bool(base) and ratio < floor
+    # -- flight recorder: artifact + validation + differ + ratchet ----
+    # the cluster-merged table is the artifact body (the coordinator's
+    # own registry alone would miss every worker-compiled kernel)
+    profile_doc = profiler_mod.profile_document(
+        "trace", extra={"device_memory": profile["device_memory"]},
+        kernels=profile["kernels"], table_totals=profile["totals"])
+    profile_path = os.environ.get(
+        "BENCH_PROFILE_PATH", os.path.join(REPO, "BENCH_PROFILE.json"))
+    baseline_doc = None
+    try:
+        baseline_doc = json.load(open(profile_path))
+    except Exception:
+        pass
+    with open(profile_path, "w") as f:
+        json.dump(profile_doc, f, indent=1)
+    problems = profiler_mod.validate_profile(profile_doc)
+    compile_s = round(profile_doc["totals"]["compile_ms"] / 1e3, 3)
+    base_compile = _load_cache().get("profile_compile_s:trace")
+    factor = float(os.environ.get("BENCH_PROFILE_COMPILE_FACTOR",
+                                  "2.0"))
+    budget = round(base_compile * factor, 3) if base_compile else None
+    compile_breach = budget is not None and compile_s > budget
+    moved = profiler_mod.diff_profiles(baseline_doc, profile_doc) \
+        if baseline_doc and not problems else []
+    print(json.dumps({
+        "metric": "profile_compile_s", "value": compile_s, "unit": "s",
+        "vs_baseline": round(compile_s / base_compile, 3)
+        if base_compile else 0.0,
+        "budget_s": budget, "programs":
+            profile_doc["totals"]["programs"],
+        "artifact": profile_path,
+    }), flush=True)
+    if moved:
+        # regression attribution: NAME the kernels that moved since
+        # the committed artifact (informational — the compile ratchet
+        # gates; a differ hit on a fresh baseline would be noise)
+        print(json.dumps({
+            "metric": "profile_moved", "value": len(moved),
+            "unit": "kernels", "vs_baseline": 0.0,
+            "moved": moved[:8],
+        }), flush=True)
     out = {
         "ok": bool(spans) and len(roots) == 1 and not orphans
         and len(workers) >= 2 and not regressed,
@@ -706,6 +764,12 @@ def _trace_smoke() -> dict:
         "worker_lanes": len(workers),
         "stage_overlap": round(overlap, 4),
         "artifact": artifact,
+        "profile_artifact": profile_path,
+        "profile_ok": not problems and not compile_breach,
+        "profile_problems": problems or None,
+        "profile_compile_s": compile_s,
+        "profile_compile_budget_s": budget,
+        "profile_kernels": len(profile_doc["kernels"]),
         "q3_wall_s": q3_wall,
         "wall_s": round(time.time() - t0, 2),
     }
@@ -726,6 +790,11 @@ def _trace_smoke() -> dict:
     print("TRACE_RESULT " + json.dumps(out), flush=True)
     if not out["ok"]:
         raise SystemExit(7)
+    if not out["profile_ok"]:
+        # DISTINCT rc: an empty/disconnected profile (the recorder
+        # never engaged) or a compile-seconds budget breach must not
+        # masquerade as a trace-tree failure
+        raise SystemExit(11)
     return out
 
 

@@ -56,7 +56,9 @@ def test_device_exchange_program_lowers_for_tpu():
 
     mesh = Mesh(np.asarray(jax.devices()[:8]), ("x",))
     types_ = (T.BIGINT, T.BIGINT)
-    prog = _exchange_program(mesh, types_, (0,), 8, 8, 32)
+    # .jit: the profiler wrapper keeps the raw jit product for
+    # export (jax.export requires the jit callable itself)
+    prog = _exchange_program(mesh, types_, (0,), 8, 8, 32).jit
     cap = 128
     cols = tuple(sds((8, cap), jnp.int64) for _ in types_)
     nulls = tuple(sds((8, cap), jnp.bool_) for _ in types_)
@@ -71,7 +73,7 @@ def test_count_program_lowers_for_tpu():
 
     mesh = Mesh(np.asarray(jax.devices()[:8]), ("x",))
     types_ = (T.BIGINT, T.BIGINT)
-    prog = _count_program(mesh, types_, (0,), 8, 8)
+    prog = _count_program(mesh, types_, (0,), 8, 8).jit
     cap = 128
     cols = tuple(sds((8, cap), jnp.int64) for _ in types_)
     nulls = tuple(sds((8, cap), jnp.bool_) for _ in types_)
@@ -90,7 +92,7 @@ def test_matmul_join_probe_lowers_for_tpu():
 
     m, kp = 4096, 1024
     ex = _export_tpu(
-        _matmul_lo_count,
+        _matmul_lo_count.jit,
         sds((m,), jnp.uint64), sds((m,), jnp.bool_),
         sds((), jnp.uint64), sds((), jnp.uint64),
         sds((kp, 2), jnp.float32))

@@ -12,7 +12,13 @@ materializes a snapshot page per scan:
 - ``system.runtime.tasks``: tasks currently tracked by live workers
   (process runner) — empty for single-process runners;
 - ``system.runtime.metrics``: the flattened metrics registry, one row
-  per (name, labels) sample — the SQL view of ``GET /v1/metrics``.
+  per (name, labels) sample — the SQL view of ``GET /v1/metrics``;
+- ``system.runtime.kernels``: the compiled-program profiler registry
+  (telemetry.profiler) — one row per compiled program with trace/
+  compile wall and XLA cost analysis; empty until profiling runs
+  (``query_profiling_enabled`` or EXPLAIN ANALYZE VERBOSE).  Process-
+  local: under the multi-process runner this is the COORDINATOR's
+  registry (worker registries ride the heartbeat metrics piggyback).
 
 System tables always execute at the coordinator: the process runner
 routes statements touching this catalog to a local execution, so the
@@ -39,7 +45,8 @@ RUNTIME_TABLES = {
         ("query_id", T.VARCHAR), ("state", T.VARCHAR),
         ("user", T.VARCHAR), ("query", T.VARCHAR),
         ("started", T.DOUBLE), ("wall_ms", T.DOUBLE),
-        ("rows", T.BIGINT), ("error_code", T.VARCHAR)),
+        ("rows", T.BIGINT), ("error_code", T.VARCHAR),
+        ("slow", T.VARCHAR)),
     "tasks": (
         ("task_id", T.VARCHAR), ("query_id", T.VARCHAR),
         ("worker", T.VARCHAR), ("state", T.VARCHAR),
@@ -47,6 +54,13 @@ RUNTIME_TABLES = {
     "metrics": (
         ("name", T.VARCHAR), ("labels", T.VARCHAR),
         ("kind", T.VARCHAR), ("value", T.DOUBLE)),
+    "kernels": (
+        ("name", T.VARCHAR), ("key", T.VARCHAR),
+        ("compiles", T.BIGINT), ("calls", T.BIGINT),
+        ("trace_ms", T.DOUBLE), ("compile_ms", T.DOUBLE),
+        ("execute_ms", T.DOUBLE), ("flops", T.DOUBLE),
+        ("bytes_accessed", T.DOUBLE), ("output_bytes", T.BIGINT),
+        ("temp_bytes", T.BIGINT), ("code_bytes", T.BIGINT)),
 }
 
 
@@ -123,6 +137,8 @@ class SystemConnector(Connector):
                 return self._query_rows()
             if table == "tasks":
                 return self._task_rows()
+            if table == "kernels":
+                return self._kernel_rows()
             return self._metric_rows()
         except Exception:
             # introspection must never fail a query over it; a torn
@@ -139,11 +155,43 @@ class SystemConnector(Connector):
             rows.append((e.query_id, "RUNNING", e.user, e.sql,
                          e.create_time,
                          round((now - e.create_time) * 1e3, 2),
-                         None, None))
+                         None, None, None))
         for e in mgr.history(self.history_limit):
+            slow = (e.stats or {}).get("slow_query")
             rows.append((e.query_id, e.state, e.user, e.sql,
                          e.create_time, round(e.wall_ms, 2),
-                         e.output_rows, e.error_code))
+                         e.output_rows, e.error_code,
+                         self._slow_text(slow)))
+        return rows
+
+    @staticmethod
+    def _slow_text(slow) -> Optional[str]:
+        """Compact rendering of a slow-query record: critical path +
+        top cost operators, one cell (the full dict stays on the
+        event)."""
+        if not slow:
+            return None
+        parts = [f"wall={slow.get('wall_ms', 0)}ms"]
+        cp = slow.get("critical_path")
+        if cp:
+            parts.append("path=" + " > ".join(
+                f"{s['name']} {s['ms']}ms" for s in cp))
+        top = slow.get("top_operators")
+        if top:
+            parts.append("top=" + ", ".join(
+                f"{o['name']} {o['busy_ms']}ms" for o in top))
+        return "; ".join(parts)
+
+    def _kernel_rows(self) -> List[tuple]:
+        from ..telemetry import profiler
+
+        rows = []
+        for e in profiler.snapshot():
+            rows.append((e["name"], e["key"], e["compiles"], e["calls"],
+                         e["trace_ms"], e["compile_ms"],
+                         e["execute_ms"], e["flops"],
+                         e["bytes_accessed"], e["output_bytes"],
+                         e["temp_bytes"], e["code_bytes"]))
         return rows
 
     def _task_rows(self) -> List[tuple]:

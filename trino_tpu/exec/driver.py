@@ -14,6 +14,7 @@ from typing import List, Optional, Sequence
 
 from .. import jit_stats
 from ..connectors.spi import ConnectorSplit
+from ..telemetry import profiler
 from ..ops.operator import Operator, SourceOperator
 
 
@@ -31,6 +32,13 @@ class OperatorStats:
     output_pages: int = 0
     wall_ns: int = 0
     compile_count: int = 0
+    #: XLA cost attribution (telemetry.profiler thread deltas): flops /
+    #: bytes accessed by this operator's compiled programs per
+    #: execution, and the compile wall it paid — all zero unless the
+    #: profiler was enabled (EXPLAIN ANALYZE VERBOSE, bench trace role)
+    flops: float = 0.0
+    device_bytes: float = 0.0
+    compile_ms: float = 0.0
     #: perf_counter_ns of this operator's first/last active quantum —
     #: with the driver's ``epoch_anchor`` these place the operator on a
     #: cross-process trace timeline (telemetry.tracing.add_driver_spans)
@@ -46,6 +54,10 @@ class OperatorStats:
         base = (f"{self.name}: {self.output_rows} rows, "
                 f"{self.output_pages} pages, {ms:.1f}ms, "
                 f"{self.compile_count} compiles")
+        if self.flops or self.device_bytes or self.compile_ms:
+            base += (f" [cost {self.flops:.3g} flops, "
+                     f"{self.device_bytes:.3g} bytes, "
+                     f"compile {self.compile_ms:.1f}ms]")
         if self.metrics:
             m = self.metrics
             if m.get("strategy"):
@@ -116,15 +128,27 @@ class Driver:
         an aggregation's finish builds its output state)."""
         t0 = time.perf_counter_ns()
         c0 = jit_stats.thread_total()
+        p0 = profiler.thread_totals()
         out = fn()
         t1 = time.perf_counter_ns()
         st = self.stats[idx]
         st.wall_ns += t1 - t0
         st.compile_count += jit_stats.thread_total() - c0
+        self._attribute_cost(st, p0)
         if st.first_ns == 0:
             st.first_ns = t0
         st.last_ns = t1
         return out
+
+    @staticmethod
+    def _attribute_cost(st: OperatorStats, before):
+        """Fold the profiler's thread-delta (flops/bytes/compile wall
+        of programs run since ``before``) into the operator stats —
+        zeros end to end unless profiling is enabled."""
+        flops, bytes_, compile_ms, _ = profiler.thread_totals()
+        st.flops += flops - before[0]
+        st.device_bytes += bytes_ - before[1]
+        st.compile_ms += compile_ms - before[2]
 
     def process(self) -> bool:
         """One scheduling quantum: move pages between adjacent operators.
@@ -143,11 +167,13 @@ class Driver:
                 if self.collect_stats:
                     t0 = time.perf_counter_ns()
                     c0 = jit_stats.thread_total()
+                    p0 = profiler.thread_totals()
                     page = cur.get_output()
                     t1 = time.perf_counter_ns()
                     st = self.stats[i]
                     st.wall_ns += t1 - t0
                     st.compile_count += jit_stats.thread_total() - c0
+                    self._attribute_cost(st, p0)
                     if st.first_ns == 0:
                         st.first_ns = t0
                     st.last_ns = t1
@@ -160,11 +186,13 @@ class Driver:
                     if self.collect_stats:
                         t0 = time.perf_counter_ns()
                         c0 = jit_stats.thread_total()
+                        p0 = profiler.thread_totals()
                         nxt.add_input(page)
                         t1 = time.perf_counter_ns()
                         st1 = self.stats[i + 1]
                         st1.wall_ns += t1 - t0
                         st1.compile_count += jit_stats.thread_total() - c0
+                        self._attribute_cost(st1, p0)
                         if st1.first_ns == 0:
                             st1.first_ns = t0
                         st1.last_ns = t1

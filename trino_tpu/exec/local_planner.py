@@ -85,20 +85,32 @@ class PhysicalPipeline:
 class LocalExecutionPlan:
     def __init__(self, pipelines: List[PhysicalPipeline],
                  sink: OutputCollectorOperator,
-                 column_names: List[str], output_types: List[T.Type]):
+                 column_names: List[str], output_types: List[T.Type],
+                 progress=None):
         self.pipelines = pipelines
         self.sink = sink
         self.column_names = column_names
         self.output_types = output_types
+        #: telemetry.progress.QueryProgress fed live task counts
+        self.progress = progress
 
     def execute(self, collect_stats: bool = False) -> List[Page]:
         from .driver import Driver
 
         self.drivers = []
+        p_ = self.progress
+        if p_ is not None:
+            p_.tasks_total = len(self.pipelines)
         for p in self.pipelines:
             d = Driver(p.operators, collect_stats=collect_stats)
             self.drivers.append(d)
-            d.run_to_completion()
+            if p_ is not None:
+                p_.task_started()
+            try:
+                d.run_to_completion()
+            finally:
+                if p_ is not None:
+                    p_.task_finished()
         return self.sink.pages
 
 
@@ -121,7 +133,7 @@ class LocalExecutionPlanner:
                  adaptive_partial_min_rows: int = ADAPTIVE_MIN_ROWS,
                  adaptive_partial_buckets: int = ADAPTIVE_KEY_BUCKETS,
                  matmul_max_key_range: int = 1024,
-                 processor_cache=None):
+                 processor_cache=None, progress=None):
         self.metadata = metadata
         self.desired_splits = desired_splits
         self.task_id = task_id
@@ -153,6 +165,9 @@ class LocalExecutionPlanner:
         #: re-tracing every expression per submission; None = build
         #: fresh per plan (the pre-cache behavior)
         self.processor_cache = processor_cache
+        #: live progress tracker (telemetry.progress.QueryProgress):
+        #: table scans feed rows_scanned, the plan feeds task counts
+        self.progress = progress
         self.pipelines: List[PhysicalPipeline] = []
         # scan-node id -> [(channel, DynamicFilter)] attachments
         self._scan_dfs: Dict[int, List] = {}
@@ -196,7 +211,7 @@ class LocalExecutionPlanner:
         self.pipelines.append(PhysicalPipeline(ops))
         return LocalExecutionPlan(
             self.pipelines, sink, root.column_names,
-            [s.type for s in root.outputs])
+            [s.type for s in root.outputs], progress=self.progress)
 
     # ------------------------------------------------------------------
 
@@ -217,7 +232,8 @@ class LocalExecutionPlanner:
                                      id(node), []),
                                  coalesce_rows=getattr(
                                      conn, "page_rows", None)
-                                 if self.scan_coalesce else None)
+                                 if self.scan_coalesce else None,
+                                 progress=self.progress)
         splits = conn.split_manager().get_splits(node.table,
                                                  self.desired_splits)
         for i, split in enumerate(splits):

@@ -40,6 +40,10 @@ class TaskStatsTree:
                  "pages": o.output_pages,
                  "wall_ms": round(o.wall_ns / 1e6, 2),
                  "compiles": o.compile_count,
+                 **({"flops": o.flops,
+                     "device_bytes": o.device_bytes,
+                     "compile_ms": round(o.compile_ms, 2)}
+                    if (o.flops or o.compile_ms) else {}),
                  **({"exchange": o.metrics} if o.metrics else {})}
                 for o in self.operators],
         }
@@ -221,12 +225,18 @@ class QueryStatsTree:
                         agg[i] = OperatorStats(o.name, o.output_rows,
                                                o.output_pages, o.wall_ns,
                                                o.compile_count,
+                                               flops=o.flops,
+                                               device_bytes=o.device_bytes,
+                                               compile_ms=o.compile_ms,
                                                metrics=o.metrics)
                     else:
                         a.output_rows += o.output_rows
                         a.output_pages += o.output_pages
                         a.wall_ns += o.wall_ns
                         a.compile_count += o.compile_count
+                        a.flops += o.flops
+                        a.device_bytes += o.device_bytes
+                        a.compile_ms += o.compile_ms
                         # exchange metrics describe the ONE shared
                         # boundary object; every task reports the same
                         # dict, so keep the first

@@ -109,8 +109,17 @@ class PageProcessor:
         else:
             self._filter_plan = None
             self._proj_plans = self._plans
-        # output dictionaries resolved per process() call
-        self._jit = jax.jit(self._run)
+        # output dictionaries resolved per process() call.
+        # profiled (telemetry.profiler) under the SAME semantic key
+        # the ProcessorCache uses — (input types, projection/filter
+        # IR) IS the program identity, so the cost registry joins
+        # cleanly with the processor cache
+        from ..telemetry.profiler import instrument
+
+        self._jit = instrument(
+            "page_processor", jax.jit(self._run),
+            key=(tuple(self.input_types), tuple(self.projections),
+                 filter_expr))
 
     @property
     def output_types(self) -> List[T.Type]:

@@ -59,6 +59,7 @@ import numpy as np
 from .. import jit_stats
 from .. import types as T
 from ..block import DevicePage, padded_size
+from ..telemetry.profiler import instrument
 from ..types import TypeError_
 from .hashtable import (_mix_operands, hash_group_ids,
                         hash_segment_reduce, hashable_key_types)
@@ -411,6 +412,11 @@ def _group_reduce(key_ops: Tuple, key_raws: Tuple, state_cols: Tuple,
     return out_key_raws, out_key_nulls, tuple(reduced), out_valid
 
 
+_group_reduce = instrument(
+    "sort_group_reduce", _group_reduce,
+    static_argnames=("num_states", "num_keys", "kinds", "pallas"))
+
+
 @partial(jax.jit, static_argnames=("buckets",))
 def _bucket_reduction_stats(key_ops: Tuple, valid, group_rows, ngroups,
                             buckets: int):
@@ -433,6 +439,11 @@ def _bucket_reduction_stats(key_ops: Tuple, valid, group_rows, ngroups,
     return jnp.stack([rows[:buckets], groups[:buckets]])
 
 
+_bucket_reduction_stats = instrument(
+    "agg_bucket_stats", _bucket_reduction_stats,
+    static_argnames=("buckets",))
+
+
 @partial(jax.jit, static_argnames=("buckets",))
 def _key_range_pass_mask(key_ops: Tuple, pass_buckets, buckets: int):
     """Per-row pass-through mask from the decided per-bucket verdicts
@@ -441,6 +452,11 @@ def _key_range_pass_mask(key_ops: Tuple, pass_buckets, buckets: int):
     n = key_ops[0].shape[0]
     b = (_mix_operands(key_ops, n) % np.uint64(buckets)).astype(jnp.int32)
     return pass_buckets[b]
+
+
+_key_range_pass_mask = instrument(
+    "agg_key_range_mask", _key_range_pass_mask,
+    static_argnames=("buckets",))
 
 
 class HashAggregationOperator(Operator):

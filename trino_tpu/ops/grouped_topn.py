@@ -29,6 +29,7 @@ import numpy as np
 
 from .. import types as T
 from ..block import DevicePage, padded_size
+from ..telemetry.profiler import instrument
 from .operator import Operator
 from .sort import _concat_pages
 from .sortkeys import SortKey, group_operands, sort_operands
@@ -82,6 +83,12 @@ def _topn_kernel(part_ops, order_ops, cols, nulls, valid,
     out_cols = tuple(c[2:2 + ncols])
     out_nulls = tuple(c[2 + ncols:2 + 2 * ncols])
     return out_cols, out_nulls, c[-2], c[-1], jnp.sum(keep)
+
+
+_topn_kernel = instrument(
+    "grouped_topn_kernel", _topn_kernel,
+    static_argnames=("n_part", "n_order", "ranking", "max_rank",
+                     "ncols"))
 
 
 class GroupedTopNOperator(Operator):

@@ -45,6 +45,7 @@ import numpy as np
 
 from .. import jit_stats
 from .. import types as T
+from ..telemetry.profiler import instrument
 
 #: linear-probe rounds per page: with load factor <= 0.5 and a 64-bit
 #: mixed hash, an unresolved row after 32 probes is astronomically rare
@@ -166,6 +167,12 @@ def hash_group_ids(key_ops: Tuple, valid, rounds: int = PROBE_ROUNDS,
     return gid, group_rows[:cap], ngroups, overflow
 
 
+# profiled entry points (telemetry.profiler): cost/compile
+# attribution under EXPLAIN ANALYZE VERBOSE; plain calls when off
+hash_group_ids = instrument("hash_group_ids", hash_group_ids,
+                            static_argnames=("rounds", "exact"))
+
+
 @partial(jax.jit, static_argnames=("kinds", "pallas"))
 def hash_segment_reduce(gid, group_rows, ngroups, key_raws: Tuple,
                         key_nulls: Tuple, state_cols: Tuple, kinds: Tuple,
@@ -204,3 +211,8 @@ def hash_segment_reduce(gid, group_rows, ngroups, key_raws: Tuple,
     out_key_raws = tuple(kr[safe_idx] for kr in key_raws)
     out_key_nulls = tuple(kn[safe_idx] & out_valid for kn in key_nulls)
     return out_key_raws, out_key_nulls, tuple(reduced), out_valid
+
+
+hash_segment_reduce = instrument(
+    "hash_segment_reduce", hash_segment_reduce,
+    static_argnames=("kinds", "pallas"))

@@ -37,6 +37,7 @@ import numpy as np
 
 from .. import types as T
 from ..block import DevicePage, padded_size
+from ..telemetry.profiler import instrument
 from .operator import Operator
 from .sortkeys import group_operands
 
@@ -112,6 +113,11 @@ def _build_sorted(key_u64, anynull, cols, nulls, valid):
     return s[0], s[1], s[2], tuple(s[3:3 + n]), tuple(s[3 + n:])
 
 
+# profiled entry point (telemetry.profiler): cost/compile attribution
+# under EXPLAIN ANALYZE VERBOSE; a plain call when profiling is off
+_build_sorted = instrument("join_build_sorted", _build_sorted)
+
+
 @jax.jit
 def _probe_counts(build_keys, build_usable, probe_keys, probe_usable):
     from .. import jit_stats
@@ -121,6 +127,9 @@ def _probe_counts(build_keys, build_usable, probe_keys, probe_usable):
     hi = jnp.searchsorted(build_keys, probe_keys, side="right")
     count = jnp.where(probe_usable, hi - lo, 0)
     return lo, count
+
+
+_probe_counts = instrument("join_probe_counts", _probe_counts)
 
 
 @partial(jax.jit, static_argnames=("out_cap",))
@@ -139,6 +148,10 @@ def _expand_matches(lo, count, out_cap: int):
     lane_valid = j < total
     return (probe_idx.astype(jnp.int32),
             jnp.clip(build_idx, 0, None).astype(jnp.int32), lane_valid)
+
+
+_expand_matches = instrument("join_expand_matches", _expand_matches,
+                             static_argnames=("out_cap",))
 
 
 @dataclass

@@ -50,6 +50,7 @@ import numpy as np
 from .. import jit_stats
 from .. import types as T
 from ..block import DevicePage
+from ..telemetry.profiler import instrument
 from .join import BuildSide, JoinBridge, LookupJoinOperator
 from .kernel_sizing import KERNEL_SIZING
 
@@ -88,6 +89,13 @@ def _build_code_table(key_sorted, klo, k_range, kp: int):
     cnt = jnp.where(live, hi - lo, 0)
     first = jnp.where(live, lo, 0)
     return jnp.stack([cnt, first], axis=1).astype(jnp.float32)
+
+
+# profiled entry points (telemetry.profiler): cost/compile
+# attribution under EXPLAIN ANALYZE VERBOSE; plain calls when off
+_build_code_table = instrument("matmul_join_build_table",
+                               _build_code_table,
+                               static_argnames=("kp",))
 
 
 def _blocked_onehot_matmul(codes, table):
@@ -137,6 +145,9 @@ def _matmul_lo_count(pkey, pusable, klo, k_range, table):
     return lo, count
 
 
+_matmul_lo_count = instrument("matmul_join_probe", _matmul_lo_count)
+
+
 @partial(jax.jit, static_argnames=("anti",))
 def _membership_page_valid(valid, count, anti: bool):
     """Semi/anti output mask straight from the matmul counts (exact
@@ -144,6 +155,11 @@ def _membership_page_valid(valid, count, anti: bool):
     jit_stats.bump("matmul_join_membership")
     matched = count > 0
     return valid & ~matched if anti else valid & matched
+
+
+_membership_page_valid = instrument(
+    "matmul_join_membership", _membership_page_valid,
+    static_argnames=("anti",))
 
 
 class MatmulJoinOperator(LookupJoinOperator):

@@ -78,9 +78,13 @@ class TableScanOperator(SourceOperator):
 
     def __init__(self, connector: Connector, columns: Sequence[ColumnHandle],
                  dynamic_filters: Sequence = (),
-                 coalesce_rows: Optional[int] = None):
+                 coalesce_rows: Optional[int] = None,
+                 progress=None):
         self.connector = connector
         self.columns = list(columns)
+        #: telemetry.progress.QueryProgress fed host-page row counts as
+        #: splits are read — a plain int add, never a device sync
+        self.progress = progress
         # [(channel, DynamicFilter)] — join build-side domains applied to
         # every scanned page as a lane-mask update (reference analog:
         # dynamic-filter TupleDomains pushed into ConnectorPageSource)
@@ -138,6 +142,8 @@ class TableScanOperator(SourceOperator):
                 return self._flush() if self._buffer else None
             if page.num_rows == 0:
                 continue
+            if self.progress is not None:
+                self.progress.add_rows(page.num_rows)
             target = self.coalesce_rows
             if target and page.num_rows < target:
                 self._buffer.append(page)

@@ -44,6 +44,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..telemetry.profiler import instrument
+
 _CHUNK = 512          # rows per grid step
 _LANE = 128           # TPU lane width: window starts are lane-aligned
 _WIN = _CHUNK + _LANE  # aligned window covering any chunk's segments
@@ -210,6 +212,14 @@ def _segment_reduce_pallas(col, gid, num_segments: int, kind: str,
     )(starts, col.reshape(n_chunks, 1, _CHUNK),
       gid.reshape(n_chunks, 1, _CHUNK))
     return out[0, :num_segments]
+
+
+# profiled entry point (telemetry.profiler): the Pallas program's
+# cost/compile attribution when called from host (inside another
+# trace the wrapper stages out inline); plain call when off
+_segment_reduce_pallas = instrument(
+    "segment_reduce_pallas", _segment_reduce_pallas,
+    static_argnames=("num_segments", "kind", "interpret"))
 
 
 def segment_reduce(col, gid, num_segments: int, kind: str,

@@ -21,6 +21,7 @@ import numpy as np
 
 from .. import types as T
 from ..block import DevicePage, padded_size
+from ..telemetry.profiler import instrument
 from .operator import Operator
 from .sortkeys import SortKey, sort_operands
 
@@ -38,6 +39,12 @@ def _sorted_by(key_ops, cols, nulls, valid, num_key_ops: int):
     base = 1 + num_key_ops
     return (tuple(s[base:base + n]), tuple(s[base + n:base + 2 * n]),
             s[-1])
+
+
+# profiled entry point (telemetry.profiler): cost/compile attribution
+# under EXPLAIN ANALYZE VERBOSE; a plain call when profiling is off
+_sorted_by = instrument("sort_by", _sorted_by,
+                        static_argnames=("num_key_ops",))
 
 
 def _make_key_ops(page: DevicePage, keys: Sequence[SortKey]):

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import types as T
+from ..telemetry.profiler import instrument
 from ..block import DevicePage, padded_size
 from ..types import TrinoError
 from .operator import Operator
@@ -357,6 +358,13 @@ def _window_kernel(part_ops, order_ops, cols, nulls, valid,
     out_nulls = tuple(jnp.zeros(n, dtype=bool) if nl is None else nl
                       for _, nl in outs)
     return s_cols, s_nulls, s_valid, out_cols, out_nulls
+
+
+# profiled entry point (telemetry.profiler): cost/compile attribution
+# under EXPLAIN ANALYZE VERBOSE; a plain call when profiling is off
+_window_kernel = instrument(
+    "window_kernel", _window_kernel,
+    static_argnames=("num_part_ops", "num_order_ops", "calls"))
 
 
 class WindowOperator(Operator):

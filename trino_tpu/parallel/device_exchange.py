@@ -77,6 +77,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from .. import jit_stats
 from .. import types as T
 from ..block import DevicePage, Dictionary, padded_size
+from ..telemetry.profiler import instrument
 from .exchange import (hash_partition_ids, key_to_u64, partition_histogram,
                        repartition_a2a, shard_map, string_hash_lut)
 
@@ -612,7 +613,10 @@ def _count_program(mesh: Mesh, types_: tuple, key_channels: tuple,
         jit_stats.bump("device_exchange_count")
         return count(cols, nulls, valid, luts)
 
-    return jax.jit(counted)
+    # profiled (telemetry.profiler) under the builder's own memo
+    # key: same-shape but different programs never alias
+    return instrument("device_exchange_count", jax.jit(counted),
+                      key=(mesh, types_, key_channels, n, d))
 
 
 @lru_cache(maxsize=128)
@@ -662,7 +666,9 @@ def _exchange_program(mesh: Mesh, types_: tuple, key_channels: tuple,
         jit_stats.bump("device_exchange_program")
         return prog(cols, nulls, valid, luts, hot)
 
-    return jax.jit(exchanged)
+    return instrument(
+        "device_exchange_program", jax.jit(exchanged),
+        key=(mesh, types_, key_channels, n, d, per_dest))
 
 
 class _DeviceExchangeToken:

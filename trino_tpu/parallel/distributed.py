@@ -109,7 +109,8 @@ class DistributedQueryRunner:
                                             ast.CreateTableAsSelect)):
             # DML included: the writer path's exchange surface (scaled
             # writers' rebalance counters) is only observable here
-            return self._explain_analyze(stmt.statement)
+            return self._explain_analyze(stmt.statement,
+                                         verbose=stmt.verbose)
         if not isinstance(stmt, ast.QueryStatement):
             if isinstance(stmt, (ast.Insert, ast.CreateTableAsSelect)):
                 # writes distribute: scaled writer tasks in the source
@@ -122,22 +123,46 @@ class DistributedQueryRunner:
                                     self.session).execute(sql)
         return self._execute_query(stmt)
 
-    def _explain_analyze(self, stmt: ast.QueryStatement) -> QueryResult:
+    def _explain_analyze(self, stmt: ast.QueryStatement,
+                         verbose: bool = False) -> QueryResult:
         """Distributed EXPLAIN ANALYZE: run collecting the query/stage/
         task stats tree and render it (reference: the QueryStats
-        hierarchy + planprinter; round-2 verdict flagged its absence)."""
-        res = self._execute_query(stmt, collect_stats=True)
+        hierarchy + planprinter; round-2 verdict flagged its absence).
+        VERBOSE enables the compiled-program profiler so per-operator
+        rows carry flops / bytes / compile-ms and a Kernels line shows
+        what this run compiled vs reused."""
+        from ..telemetry import profiler
+
+        before = profiler.totals() if verbose else None
+        with profiler.profiling(verbose):
+            res = self._execute_query(stmt, collect_stats=True)
         tree = res.stats["query_stats"]
         # _execute_query already planned + fragmented; render those
         lines = fragments_str(self._fragments).splitlines()
         lines.append("")
         lines.extend(tree.render())
+        if verbose:
+            from ..runner import _kernels_line
+
+            lines.append(_kernels_line(before, profiler.totals()))
         return QueryResult(["Query Plan"], [T.VARCHAR],
                            [(line,) for line in lines],
                            stats={"query_stats": tree.to_dict()})
 
     def _execute_query(self, stmt: ast.QueryStatement,
                        collect_stats: bool = False) -> QueryResult:
+        """Profiling envelope around the execution body: the
+        ``query_profiling_enabled`` session knob turns the compiled-
+        program registry on for this query (EXPLAIN ANALYZE VERBOSE
+        layers its own ``profiling(True)`` on top)."""
+        from ..telemetry.profiler import profiling
+
+        with profiling(SP.value(self.session,
+                                "query_profiling_enabled")):
+            return self._execute_query_body(stmt, collect_stats)
+
+    def _execute_query_body(self, stmt: ast.QueryStatement,
+                            collect_stats: bool = False) -> QueryResult:
         import time as _time
 
         from ..exec.stats import QueryStatsTree, StageStatsTree

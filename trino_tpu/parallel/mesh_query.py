@@ -43,6 +43,7 @@ from ..ops.global_hash_agg import (EMPTY, global_hash_insert,
                                    unpack_keys)
 from ..ops.kernel_sizing import KERNEL_SIZING
 from ..ops.sortkeys import group_operands
+from ..telemetry.profiler import instrument
 from .exchange import (hash_partition_ids, partition_histogram,
                        repartition_a2a, shard_map, subbucket_ids)
 
@@ -335,8 +336,11 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
                                        _build_q1_programs)
     luts = proc._fill_luts(dicts)
 
-    s1 = _cached_program(("stage1", mesh, tsig),
-                         lambda: q1_stage1_fn(mesh, proc, step))
+    s1 = _cached_program(
+        ("stage1", mesh, tsig),
+        lambda: instrument("mesh_q1_stage1",
+                           q1_stage1_fn(mesh, proc, step),
+                           key=("stage1", mesh, tsig)))
     kr, kn, states, pvalid, part, hist, need = s1(
         tuple(cols), tuple(nulls), valid, luts)
     part_rows = np.asarray(hist)[0]
@@ -384,7 +388,10 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
     if strategy == "global-hash":
         fn = _cached_program(
             ("global_hash", mesh, tsig, table_size),
-            lambda: q1_global_hash_fn(mesh, proc, aggs, table_size))
+            lambda: instrument(
+                "mesh_q1_global_hash",
+                q1_global_hash_fn(mesh, proc, aggs, table_size),
+                key=("global_hash", mesh, tsig, table_size)))
         out_cols, out_nulls, out_valid, unresolved = fn(
             kr, kn, states, pvalid)
         jax.block_until_ready(out_valid)
@@ -420,7 +427,10 @@ def run_q1_mesh(devices: Sequence, schema: str = "micro",
         while True:
             fn = _cached_program(
                 ("final", mesh, tsig, per_dest),
-                lambda: q1_exchange_final_fn(mesh, proc, aggs, per_dest))
+                lambda: instrument(
+                    "mesh_q1_exchange_final",
+                    q1_exchange_final_fn(mesh, proc, aggs, per_dest),
+                    key=("final", mesh, tsig, per_dest)))
             out_cols, out_nulls, out_valid, overflow = fn(
                 kr, kn, states, pvalid, part, hot_mask)
             jax.block_until_ready(out_valid)

@@ -670,7 +670,8 @@ class ProcessQueryRunner:
             return res
         if isinstance(stmt, ast.Explain) and stmt.analyze and \
                 isinstance(stmt.statement, ast.QueryStatement):
-            return self._explain_analyze(stmt.statement)
+            return self._explain_analyze(stmt.statement,
+                                         verbose=stmt.verbose)
         if isinstance(stmt, (ast.QueryStatement, ast.Insert,
                              ast.CreateTableAsSelect)):
             res = self._execute_with_retry(stmt)
@@ -707,12 +708,16 @@ class ProcessQueryRunner:
 
         return walk(stmt)
 
-    @staticmethod
-    def _event_stats(res: QueryResult, t0: float) -> dict:
+    def _event_stats(self, res: QueryResult, t0: float) -> dict:
         """The QueryCompletedEvent stats payload (reference:
         QueryStatistics): peak memory, recovery counters, and a
-        coordinator wall breakdown derived from the trace spans."""
+        coordinator wall breakdown derived from the trace spans.  A
+        wall past ``slow_query_log_threshold`` additionally attaches
+        the structured slow-query record (trace critical path + top-3
+        cost-attributed operators) that system.runtime.queries
+        renders."""
         stats = res.stats or {}
+        wall_s = time.perf_counter() - t0
         breakdown: Dict[str, float] = {}
         for s in stats.get("trace") or ():
             if s.get("process") == "coordinator":
@@ -720,22 +725,39 @@ class ProcessQueryRunner:
                 breakdown[name] = round(
                     breakdown.get(name, 0.0)
                     + (s["end"] - s["start"]) * 1e3, 2)
-        return {
-            "wall_ms": round((time.perf_counter() - t0) * 1e3, 2),
+        out = {
+            "wall_ms": round(wall_s * 1e3, 2),
             "peak_memory_bytes":
                 (stats.get("memory") or {}).get("peak_bytes", 0),
             "recovery": stats.get("recovery"),
             "cluster_memory": stats.get("cluster_memory"),
             "wall_breakdown": breakdown or None,
         }
+        threshold = SP.value(self.session, "slow_query_log_threshold")
+        if threshold and wall_s > threshold:
+            from ..telemetry.tracing import slow_query_record
 
-    def _explain_analyze(self, stmt) -> QueryResult:
+            out["slow_query"] = slow_query_record(
+                stats.get("trace"), wall_s * 1e3, threshold)
+        return out
+
+    def _explain_analyze(self, stmt,
+                         verbose: bool = False) -> QueryResult:
         """Distributed EXPLAIN ANALYZE: run the query through the full
         retry machinery and render wall time + recovery counters
         (exec/stats.QueryStatsTree — the reference's QueryStats
-        hierarchy surface)."""
+        hierarchy surface).  VERBOSE ships
+        ``query_profiling_enabled`` to every task, so worker operator
+        spans carry flops / compile-ms and the Trace line splits the
+        critical path into compile vs execute; a Kernels line
+        summarizes the cluster-wide program registries."""
+        from ..telemetry import profiler
+
         t0 = time.perf_counter()
-        res = self._execute_with_retry(stmt)
+        with profiler.profiling(verbose):
+            res = self._execute_with_retry(
+                stmt, extra_props={"query_profiling_enabled": True}
+                if verbose else None)
         tree = QueryStatsTree(
             wall_ms=(time.perf_counter() - t0) * 1e3,
             memory=(res.stats or {}).get("memory"),
@@ -744,8 +766,51 @@ class ProcessQueryRunner:
             trace=(res.stats or {}).get("trace"))
         lines = tree.render()
         lines.append(f"Output: {len(res.rows)} rows")
+        if verbose:
+            snap = self.profile_snapshot()
+            tot = snap["totals"]
+            lines.append(
+                f"Kernels: {tot['programs']} programs over "
+                f"{1 + sum(1 for w in self.workers if w.alive)} "
+                f"processes, {tot['compiles']} compiles "
+                f"(compile {tot['compile_ms']:.1f}ms)")
         return QueryResult(["Query Plan"], [T.VARCHAR],
                            [(line,) for line in lines])
+
+    def profile_snapshot(self) -> dict:
+        """Cluster-wide flight-recorder table: the coordinator's
+        program registry merged with every live worker's (the
+        ``profile`` RPC), each row stamped with its process — the
+        BENCH_PROFILE.json body."""
+        from ..telemetry import profiler
+
+        kernels = [dict(k, process="coordinator")
+                   for k in profiler.snapshot()]
+        totals = profiler.totals()
+        device_memory = {}
+        dm = profiler.device_memory_stats()
+        if dm:
+            device_memory["coordinator"] = dm
+        for i, w in enumerate(self.workers):
+            if not w.alive:
+                continue
+            try:
+                resp = w.rpc({"op": "profile"}, timeout=30)
+            except Exception:  # qlint: ignore[taxonomy] observability
+                continue  # a dead worker must not fail the snapshot
+            kernels.extend(dict(k, process=f"worker-{i}")
+                           for k in resp.get("kernels") or ())
+            wt = resp.get("totals") or {}
+            for key in ("programs", "compiles", "calls", "fallbacks"):
+                totals[key] = totals.get(key, 0) + wt.get(key, 0)
+            for key in ("trace_ms", "compile_ms", "execute_ms",
+                        "flops", "bytes_accessed"):
+                totals[key] = round(
+                    totals.get(key, 0.0) + wt.get(key, 0.0), 3)
+            if resp.get("device_memory"):
+                device_memory[f"worker-{i}"] = resp["device_memory"]
+        return {"kernels": kernels, "totals": totals,
+                "device_memory": device_memory}
 
     def _write_target(self, stmt) -> Optional[Tuple[str, str, str]]:
         name = stmt.table if isinstance(stmt, (ast.Insert, ast.Delete)) \
@@ -772,8 +837,14 @@ class ProcessQueryRunner:
 
     # -- query execution -------------------------------------------------
 
-    def _execute_with_retry(self, stmt) -> QueryResult:
+    def _execute_with_retry(self, stmt,
+                            extra_props: Optional[dict] = None
+                            ) -> QueryResult:
         ctx = _QueryCtx(self.session, f"q{self._task_seq + 1}")
+        if extra_props:
+            # rides _session_for() into every task request (the same
+            # channel the memory-escalation overrides use)
+            ctx.session_overrides.update(extra_props)
         if SP.value(self.session, "query_tracing_enabled"):
             ctx.tracer = Tracer(process="coordinator")
         try:
@@ -782,8 +853,20 @@ class ProcessQueryRunner:
                 ctx.root_span = root
                 res = self._retry_loop(stmt, ctx)
             if ctx.tracer.enabled:
-                res.stats = dict(res.stats or {},
-                                 trace=ctx.tracer.finished())
+                spans = ctx.tracer.finished()
+                res.stats = dict(res.stats or {}, trace=spans)
+                endpoint = SP.value(self.session,
+                                    "tracing_otlp_endpoint")
+                if endpoint:
+                    # best-effort OTLP export of the finished tree on a
+                    # daemon thread — a dead/slow collector must never
+                    # fail OR STALL the query (the 2 s socket timeout
+                    # would otherwise ride the completion path)
+                    from ..telemetry.tracing import export_otlp
+
+                    threading.Thread(target=export_otlp,
+                                     args=(endpoint, list(spans)),
+                                     daemon=True).start()
             return res
         finally:
             self.recovery_total.merge(ctx.recovery)

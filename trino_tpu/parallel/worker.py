@@ -208,6 +208,13 @@ class WorkerServer:
             with self._lock:
                 self.tasks.pop(req["task_id"], None)
             send_msg(sock, {"ok": True})
+        elif op == "profile":
+            from ..telemetry import profiler
+
+            send_msg(sock, {
+                "kernels": profiler.snapshot(),
+                "totals": profiler.totals(),
+                "device_memory": profiler.device_memory_stats()})
         elif op == "ping":
             # the heartbeat PIGGYBACKS the node pool snapshot AND the
             # metrics-registry snapshot: the coordinator's
@@ -584,6 +591,26 @@ class WorkerServer:
                           fault: Optional[dict] = None,
                           memory_pool=None, tracer=None,
                           task_span=None) -> int:
+        """Profiling envelope: SCOPED to this fragment execution (the
+        refcounted ``profiling`` context), so one VERBOSE/bench query
+        cannot leave the per-call profiled path enabled for every later
+        query on this worker — the session property's zero-cost-when-
+        off claim holds per task."""
+        from .. import session_properties as SP
+        from ..telemetry.profiler import profiling
+
+        with profiling(SP.prop_value(req.get("session", {}),
+                                     "query_profiling_enabled")):
+            return self._execute_fragment_body(
+                req, state, streaming=streaming, fault=fault,
+                memory_pool=memory_pool, tracer=tracer,
+                task_span=task_span)
+
+    def _execute_fragment_body(self, req: dict, state: _TaskState,
+                               streaming: bool = False,
+                               fault: Optional[dict] = None,
+                               memory_pool=None, tracer=None,
+                               task_span=None) -> int:
         from ..exec.driver import Driver
         from ..exec.local_planner import (LocalExecutionPlanner,
                                           grouping_options,

@@ -126,12 +126,14 @@ class LocalQueryRunner:
         prov = provenance_lines(root)
         return text + ("\n" + "\n".join(prov) if prov else "")
 
-    def execute(self, sql: str, user: Optional[str] = None
-                ) -> QueryResult:
+    def execute(self, sql: str, user: Optional[str] = None,
+                progress=None) -> QueryResult:
         """Admission (resource group) + access control + event firing
         around one statement (reference: DispatchManager.createQuery's
         admission path + QueryMonitor).  ``user`` overrides the session
-        user for admission routing (multi-tenant protocol serving)."""
+        user for admission routing (multi-tenant protocol serving);
+        ``progress`` is an optional telemetry.progress.QueryProgress
+        the execution feeds live (protocol GET /v1/query/{id})."""
         user = user or self.session.user
         self.access_control.check_can_execute_query(user)
         if self.resource_groups is not None:
@@ -142,8 +144,9 @@ class LocalQueryRunner:
             # charge against the group's soft/hard memory limits
             with group.run(memory_bytes=SP.value(
                     self.session, "query_max_memory_bytes")):
-                return self._monitored_execute(sql, user)
-        return self._monitored_execute(sql, user)
+                return self._monitored_execute(sql, user,
+                                               progress=progress)
+        return self._monitored_execute(sql, user, progress=progress)
 
     def execute_batch(self, sqls: Sequence[str],
                       user: Optional[str] = None) -> List:
@@ -201,7 +204,8 @@ class LocalQueryRunner:
                 return run_all()
         return run_all()
 
-    def _monitored_execute(self, sql: str, user: str) -> QueryResult:
+    def _monitored_execute(self, sql: str, user: str,
+                           progress=None) -> QueryResult:
         import time as _time
 
         from .events import QueryMonitor
@@ -212,24 +216,47 @@ class LocalQueryRunner:
         if monitor:
             monitor.created()
         try:
-            res = self._execute_sql(sql, user=user)
+            res = self._execute_sql(sql, user=user, progress=progress)
         except Exception as e:
             if monitor:
                 monitor.failed(e)
             raise
+        wall_s = _time.perf_counter() - t0
         if monitor:
             # the QueryStatistics analog: peak memory + wall ride the
             # completed event into the history ring buffer that backs
             # system.runtime.queries
-            monitor.completed(len(res.rows), stats={
-                "wall_ms": round((_time.perf_counter() - t0) * 1e3, 2),
+            stats = {
+                "wall_ms": round(wall_s * 1e3, 2),
                 "peak_memory_bytes": ((res.stats or {}).get("memory")
                                       or {}).get("peak_bytes", 0),
-            })
+            }
+            slow = self._slow_query_record(sql, wall_s, res)
+            if slow is not None:
+                stats["slow_query"] = slow
+            monitor.completed(len(res.rows), stats=stats)
         return res
 
-    def _execute_sql(self, sql: str,
-                     user: Optional[str] = None) -> QueryResult:
+    def _slow_query_record(self, sql: str, wall_s: float,
+                           res: QueryResult) -> Optional[dict]:
+        """The slow-query log record when ``wall_s`` exceeds
+        ``slow_query_log_threshold`` (0 = disabled): wall + threshold,
+        the trace critical path when the run carried spans, and the
+        top-3 cost-attributed operators (by busy wall, carrying
+        flops/compile-ms when the profiler recorded them).  Rides the
+        QueryCompletedEvent stats into system.runtime.queries."""
+        from . import session_properties as SP
+
+        threshold = SP.value(self.session, "slow_query_log_threshold")
+        if not threshold or wall_s <= threshold:
+            return None
+        from .telemetry.tracing import slow_query_record
+
+        return slow_query_record((res.stats or {}).get("trace"),
+                                 wall_s * 1e3, threshold)
+
+    def _execute_sql(self, sql: str, user: Optional[str] = None,
+                     progress=None) -> QueryResult:
         # memoized parse + shape analysis: repeat statement texts skip
         # the parser entirely (the cache also feeds the admission
         # batcher's shape grouping)
@@ -238,7 +265,8 @@ class LocalQueryRunner:
         stmt = pq.stmt
         if isinstance(stmt, ast.Explain):
             if stmt.analyze:
-                return self._explain_analyze(stmt.statement)
+                return self._explain_analyze(stmt.statement,
+                                             verbose=stmt.verbose)
             from .planner.optimizer import provenance_lines
 
             root = self.plan_statement(stmt.statement)
@@ -307,10 +335,11 @@ class LocalQueryRunner:
                 stmt.table, self.session)
             self.access_control.check_can_insert(
                 user, catalog, schema, table)
-        return self._execute_query(pq, stmt, user)
+        return self._execute_query(pq, stmt, user,
+                                   progress=progress)
 
-    def _execute_query(self, pq, stmt: ast.Statement,
-                       user: str) -> QueryResult:
+    def _execute_query(self, pq, stmt: ast.Statement, user: str,
+                       progress=None) -> QueryResult:
         """The cached hot path.  Lookup order: result cache (rows, WITH
         literals) -> plan cache (optimized root, skips analyze/plan/
         optimize) -> full planning.  Either cache key embeds the
@@ -342,6 +371,8 @@ class LocalQueryRunner:
                         user, catalog, schema, table, cols)
                 # fresh list per hit: a caller sorting rows in place
                 # must not corrupt the cached copy
+                if progress is not None:
+                    progress.state = "FINISHED"
                 return QueryResult(list(names), list(types_),
                                    list(rows),
                                    stats={"result_cache": "hit"})
@@ -355,20 +386,31 @@ class LocalQueryRunner:
                     key, root,
                     SP.value(self.session, "plan_cache_entries"))
         self._check_table_access(stmt, root, user)  # on EVERY run
+        if progress is not None:
+            # rows-based completion estimate from connector statistics
+            progress.total_rows = self._scan_rows_estimate(root)
+            progress.state = "RUNNING"
         local = self._make_local_planner(
             processor_cache=self.query_cache.processors
-            if plan_caching else None)
-        try:
-            plan = local.plan(root)
-            pages = plan.execute()
-            rows: List[tuple] = []
-            for p in pages:
-                rows.extend(p.to_rows())
-            stats = {"memory": local.memory_pool.stats()}
-        finally:
-            # reap spill files + free residue on success AND failure —
-            # a failed spilling query must not leak its spill directory
-            local.memory_pool.close()
+            if plan_caching else None, progress=progress)
+        from .telemetry.profiler import profiling
+
+        with profiling(SP.value(self.session,
+                                "query_profiling_enabled")):
+            try:
+                plan = local.plan(root)
+                pages = plan.execute()
+                rows: List[tuple] = []
+                for p in pages:
+                    rows.extend(p.to_rows())
+                stats = {"memory": local.memory_pool.stats()}
+            finally:
+                # reap spill files + free residue on success AND
+                # failure — a failed spilling query must not leak its
+                # spill directory
+                local.memory_pool.close()
+        if progress is not None:
+            progress.state = "FINISHED"
         if local.dynamic_filters:
             stats["dynamic_filters"] = [df.stats()
                                         for df in local.dynamic_filters]
@@ -399,8 +441,8 @@ class LocalQueryRunner:
 
         return SP.value(self.session, "join_max_expand_lanes")
 
-    def _make_local_planner(self, processor_cache=None
-                            ) -> LocalExecutionPlanner:
+    def _make_local_planner(self, processor_cache=None,
+                            progress=None) -> LocalExecutionPlanner:
         """Session-configured planner: ALL execution paths (execute,
         EXPLAIN ANALYZE, the DELETE rewrite) must honor the same
         session knobs."""
@@ -414,27 +456,51 @@ class LocalQueryRunner:
             dynamic_filtering=SP.value(self.session,
                                        "enable_dynamic_filtering"),
             scan_coalesce=SP.value(self.session, "scan_coalesce_enabled"),
-            processor_cache=processor_cache,
+            processor_cache=processor_cache, progress=progress,
             **grouping_options(self.session.properties))
 
-    def _explain_analyze(self, stmt: ast.Statement) -> QueryResult:
+    def _scan_rows_estimate(self, root: OutputNode) -> int:
+        """Connector-statistics row estimate summed over the plan's
+        scans — the denominator of the rows-based progress fraction
+        (0 when no connector reports statistics)."""
+        total = 0.0
+        for catalog, schema, table, _cols in self._scan_refs(root):
+            try:
+                conn = self.metadata.connectors.get(catalog)
+                handle = conn.metadata().get_table_handle(schema, table)
+                stats = conn.metadata().get_statistics(handle)
+                if stats.row_count:
+                    total += stats.row_count
+            except Exception:
+                continue  # statistics are advisory, never fail a query
+        return int(total)
+
+    def _explain_analyze(self, stmt: ast.Statement,
+                         verbose: bool = False) -> QueryResult:
         """Run the query collecting per-operator stats, render the plan
         + stats (reference: operator/ExplainAnalyzeOperator.java +
-        planprinter/PlanPrinter.java)."""
+        planprinter/PlanPrinter.java).  VERBOSE additionally enables
+        the compiled-program profiler for the run, so operator lines
+        carry flops / bytes / compile-ms and a Kernels summary renders
+        the programs this query compiled vs reused."""
         import time as _time
+
+        from .telemetry import profiler
 
         root = self.plan_statement(stmt)
         self._check_table_access(stmt, root)  # ANALYZE executes the query
         local = self._make_local_planner()
         pool = local.memory_pool
-        try:
-            plan = local.plan(root)
-            t0 = _time.perf_counter()
-            pages = plan.execute(collect_stats=True)
-            wall = _time.perf_counter() - t0
-            m = pool.stats()
-        finally:
-            pool.close()
+        before = profiler.totals() if verbose else None
+        with profiler.profiling(verbose):
+            try:
+                plan = local.plan(root)
+                t0 = _time.perf_counter()
+                pages = plan.execute(collect_stats=True)
+                wall = _time.perf_counter() - t0
+                m = pool.stats()
+            finally:
+                pool.close()
         out_rows = sum(p.num_rows for p in pages)
         lines = plan_tree_str(root).splitlines()
         lines.append("")
@@ -450,6 +516,8 @@ class LocalQueryRunner:
             lines.append(f"Pipeline {i}:")
             for st in d.stats:
                 lines.append("  " + st.line())
+        if verbose:
+            lines.append(_kernels_line(before, profiler.totals()))
         return QueryResult(["Query Plan"], [T.VARCHAR],
                            [(line,) for line in lines])
 
@@ -576,3 +644,16 @@ class LocalQueryRunner:
                            [(before - sum(p.num_rows
                                           for p in res_pages),)])
 
+
+
+def _kernels_line(before: dict, after: dict) -> str:
+    """One EXPLAIN ANALYZE VERBOSE line: what this run compiled vs
+    reused from the program registry (a repeat-shape run must show
+    "0 new programs" — the cost-granularity no-retrace invariant)."""
+    new_programs = after["programs"] - before["programs"]
+    new_compiles = after["compiles"] - before["compiles"]
+    compile_ms = after["compile_ms"] - before["compile_ms"]
+    trace_ms = after["trace_ms"] - before["trace_ms"]
+    return (f"Kernels: {after['programs']} programs in registry, "
+            f"{new_programs} new, {new_compiles} compiles this run "
+            f"(trace {trace_ms:.1f}ms, compile {compile_ms:.1f}ms)")

@@ -117,6 +117,16 @@ class ProtocolServer:
         self._finished_lock = threading.Lock()
         self.history_size = history_size
         self.registry = MetricsRegistry()
+        # progress-capable runner? (LocalQueryRunner.execute takes a
+        # telemetry.progress tracker; other runners are served state-
+        # only live stats)
+        import inspect
+
+        try:
+            self._progress_capable = "progress" in inspect.signature(
+                runner.execute).parameters
+        except (TypeError, ValueError):
+            self._progress_capable = False
         self._http_queries = self.registry.counter(
             "trino_http_statements_total",
             "Statements submitted over /v1/statement, by outcome")
@@ -263,9 +273,13 @@ class ProtocolServer:
             and SP.value(session, "admission_batching_enabled")
 
     def submit(self, sql: str, user: Optional[str] = None) -> dict:
+        from ..telemetry import progress as progress_mod
+
         qid = uuid.uuid4().hex[:16]
         q = _QueryState(qid, sql, user=user)
         self.queries[qid] = q
+        if self._progress_capable:
+            progress_mod.register(qid)
         if self._batching_enabled():
             # shape analysis is the memoized parse the execution reuses
             # — a burst of repeat texts pays it once, ever
@@ -291,14 +305,21 @@ class ProtocolServer:
     def _run_single(self, q: _QueryState):
         import time
 
+        from ..telemetry import progress as progress_mod
+
         q.state = "RUNNING"
         t0 = time.perf_counter()
+        prog = progress_mod.get(q.id) if self._progress_capable \
+            else None
         try:
             # per-tenant admission routing needs the user-aware execute
             # (LocalQueryRunner); other runners keep their session user
             if q.user is not None and hasattr(self.runner,
                                               "execute_batch"):
-                q.result = self.runner.execute(q.sql, user=q.user)
+                q.result = self.runner.execute(q.sql, user=q.user,
+                                               progress=prog)
+            elif prog is not None:
+                q.result = self.runner.execute(q.sql, progress=prog)
             else:
                 q.result = self.runner.execute(q.sql)
             q.state = "FINISHED"
@@ -391,11 +412,21 @@ class ProtocolServer:
             while len(self.finished) >= self.history_size:
                 self.finished.pop(next(iter(self.finished)))
             self.finished[q.id] = info
+        from ..telemetry import progress as progress_mod
+
+        progress_mod.unregister(q.id)
 
     def query_info(self, qid: str) -> Optional[dict]:
         """GET /v1/query/{id}: full stats-tree JSON for a finished (or
-        failed) query, live state for one still executing, None (404)
-        for unknown/evicted ids."""
+        failed) query; for a QUEUED/RUNNING query, LIVE partial stats —
+        state, elapsed wall, and (when the runner feeds a progress
+        tracker) the rows-based completion estimate with queued/running
+        task counts — instead of the old stats:null placeholder.  None
+        (404) for unknown/evicted ids."""
+        import time
+
+        from ..telemetry import progress as progress_mod
+
         with self._finished_lock:
             done = self.finished.get(qid)
         if done is not None:
@@ -403,8 +434,13 @@ class ProtocolServer:
         q = self.queries.get(qid)
         if q is None:
             return None
+        stats = {"state": q.state,
+                 "elapsed_ms": round((time.time() - q.created) * 1e3, 1)}
+        prog = progress_mod.get(qid)
+        if prog is not None:
+            stats["progress"] = prog.to_dict()
         return {"queryId": qid, "state": q.state, "query": q.sql,
-                "error": q.error, "stats": None}
+                "error": q.error, "stats": stats}
 
     def evict_query(self, qid: str):
         """Drop a finished query from the /v1/query history (tests +

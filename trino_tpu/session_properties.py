@@ -58,6 +58,13 @@ plan_cache_enabled, plan_cache_entries,    runner.py
 result_cache_enabled
 admission_batching_enabled,                server/protocol.py
 admission_batch_max
+query_profiling_enabled                    runner.py,
+                                           parallel/distributed.py,
+                                           parallel/process_runner.py,
+                                           parallel/worker.py
+slow_query_log_threshold                   runner.py,
+                                           parallel/process_runner.py
+tracing_otlp_endpoint                      parallel/process_runner.py
 ========================================== ===========================
 """
 
@@ -379,6 +386,26 @@ register(SessionProperty(
     "admission_batch_max", "integer", 16,
     "Largest statement burst one admission slot may absorb",
     lambda v: v >= 2))
+register(SessionProperty(
+    "query_profiling_enabled", "boolean", False,
+    "Compiled-program profiling (telemetry.profiler): record trace/"
+    "compile wall and XLA cost_analysis/memory_analysis per program, "
+    "attribute flops/bytes/compile-ms per operator, and serve the "
+    "registry on system.runtime.kernels. Zero-cost when off (the "
+    "profiler is never consulted inside traced code); EXPLAIN ANALYZE "
+    "VERBOSE enables it for its own run regardless"))
+register(SessionProperty(
+    "slow_query_log_threshold", "double", 0.0,
+    "Seconds of query wall time above which a structured slow-query "
+    "record (trace critical path + top cost-attributed operators) is "
+    "attached to the QueryCompletedEvent and surfaced in "
+    "system.runtime.queries. 0 disables the log"))
+register(SessionProperty(
+    "tracing_otlp_endpoint", "varchar", "",
+    "OTLP/HTTP collector URL (e.g. http://host:4318/v1/traces): when "
+    "set, the finished span tree of every traced query exports "
+    "best-effort as OTLP JSON; empty = no export, and failures are "
+    "silently swallowed (an exporter must never fail a query)"))
 register(SessionProperty(
     "device_exchange_sizing", "varchar", "history",
     "How the device collective picks its all_to_all lane capacity "

@@ -411,6 +411,9 @@ class QueryStatement(Statement):
 class Explain(Statement):
     statement: Statement
     analyze: bool = False
+    #: EXPLAIN ANALYZE VERBOSE: also profile compiled programs and
+    #: render per-operator flops/bytes/compile-ms
+    verbose: bool = False
     type: str = "LOGICAL"  # LOGICAL | DISTRIBUTED | IO
 
 

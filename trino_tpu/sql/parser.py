@@ -193,7 +193,15 @@ class Parser:
         if self.at_kw("explain"):
             self.advance()
             analyze = bool(self.accept_kw("analyze"))
-            return ast.Explain(self._statement(), analyze=analyze)
+            # VERBOSE is a soft keyword: only meaningful right after
+            # ANALYZE, so a column named "verbose" stays an identifier
+            verbose = False
+            if analyze and self.tok.kind == "ident" \
+                    and self.tok.value.lower() == "verbose":
+                self.advance()
+                verbose = True
+            return ast.Explain(self._statement(), analyze=analyze,
+                               verbose=verbose)
         if self.at_kw("show"):
             return self._show()
         if self.at_kw("describe"):

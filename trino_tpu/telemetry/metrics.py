@@ -275,6 +275,33 @@ def process_families(tasks: Optional[int] = None,
         reg.gauge("trino_node_memory_queries",
                   "Queries holding reservations on this node").set(
             len(memory.get("queries", {})))
+    from . import profiler
+
+    ptot = profiler.totals()
+    if ptot["programs"]:
+        pc = reg.counter(
+            "trino_profiler_programs_total",
+            "Compiled-program registry counters "
+            "(kind=programs|compiles|fallbacks)")
+        pc.inc(ptot["programs"], kind="programs")
+        pc.inc(ptot["compiles"], kind="compiles")
+        pc.inc(ptot["fallbacks"], kind="fallbacks")
+        ps = reg.counter(
+            "trino_profiler_seconds_total",
+            "Wall seconds spent in XLA trace/compile, from the "
+            "compiled-program profiler (kind=trace|compile)")
+        ps.inc(ptot["trace_ms"] / 1e3, kind="trace")
+        ps.inc(ptot["compile_ms"] / 1e3, kind="compile")
+    dm = profiler.device_memory_stats()
+    if dm:
+        # live/peak device memory piggybacks beside the pool snapshot
+        # on the same heartbeat (PR 4's transport pattern)
+        g = reg.gauge("trino_device_memory_bytes",
+                      "Backend-reported device memory summed over "
+                      "local devices (kind=live|peak|limit)")
+        g.set(dm["live_bytes"], kind="live")
+        g.set(dm["peak_bytes"], kind="peak")
+        g.set(dm["limit_bytes"], kind="limit")
     return reg.collect()
 
 

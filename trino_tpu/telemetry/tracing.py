@@ -210,6 +210,13 @@ def add_driver_spans(tracer: Tracer, driver, parent) -> int:
                       "span_kind": "operator",
                       "last_activity": epoch0 + (st.last_ns - pc0) / 1e9},
         }
+        # profiler cost attribution (EXPLAIN ANALYZE VERBOSE): the span
+        # carries its operator's flops/bytes/compile wall so the
+        # critical path can split compile-vs-execute
+        if st.flops or st.compile_ms:
+            span["attrs"]["flops"] = st.flops
+            span["attrs"]["device_bytes"] = st.device_bytes
+            span["attrs"]["compile_ms"] = round(st.compile_ms, 3)
         if st.metrics:
             for key in ("kind", "first_page_ms", "reconnects",
                         "replayed_frames", "skew_ratio",
@@ -263,7 +270,10 @@ def critical_path(spans: List[dict]) -> List[dict]:
 
 def trace_line(spans: List[dict]) -> Optional[str]:
     """One EXPLAIN ANALYZE line: the critical path with per-span
-    durations, plus tree-health counts."""
+    durations, plus tree-health counts.  When operator spans carry
+    profiler cost attribution (VERBOSE runs), the line also splits the
+    path's wall into compile vs execute — the "why was it slow"
+    attribution PR 6's where-did-time-go line could not give."""
     if not spans:
         return None
     path = critical_path(spans)
@@ -271,8 +281,48 @@ def trace_line(spans: List[dict]) -> Optional[str]:
     steps = " > ".join(
         f"{s['name']} {(s['end'] - s['start']) * 1e3:.1f}ms"
         for s in path)
-    return (f"Trace: {len(spans)} spans ({len(orphans)} orphans), "
+    line = (f"Trace: {len(spans)} spans ({len(orphans)} orphans), "
             f"critical path: {steps}")
+    # compile wall over the WHOLE tree (operator spans are leaves, so
+    # no double counting): the critical path frequently ends on a
+    # consumer waiting at an exchange while the compile burned inside
+    # producer tasks — attribution must not vanish with it.  Summed
+    # compile can exceed the root wall when processes compile in
+    # parallel; execute clamps at zero.
+    compile_ms = sum(s.get("attrs", {}).get("compile_ms", 0.0)
+                     for s in spans)
+    if compile_ms:
+        total_ms = (path[0]["end"] - path[0]["start"]) * 1e3
+        line += (f" [compile {compile_ms:.1f}ms / execute "
+                 f"{max(total_ms - compile_ms, 0.0):.1f}ms]")
+    return line
+
+
+def slow_query_record(spans: Optional[List[dict]], wall_ms: float,
+                      threshold_s: float) -> dict:
+    """The structured slow-query log record
+    (``slow_query_log_threshold``): wall + threshold, the trace
+    critical path, and the top-3 cost-attributed operators (by busy
+    wall, carrying flops/compile-ms when the profiler recorded them).
+    One builder shared by every runner so the system.runtime.queries
+    renderings cannot drift."""
+    record = {"wall_ms": round(wall_ms, 2), "threshold_s": threshold_s,
+              "critical_path": None, "top_operators": []}
+    if spans:
+        record["critical_path"] = [
+            {"name": s["name"],
+             "ms": round((s["end"] - s["start"]) * 1e3, 1)}
+            for s in critical_path(spans)]
+        ops = [s for s in spans
+               if s.get("attrs", {}).get("span_kind") == "operator"]
+        ops.sort(key=lambda s: -s["attrs"].get("busy_ms", 0.0))
+        record["top_operators"] = [
+            {"name": s["name"],
+             "busy_ms": s["attrs"].get("busy_ms", 0.0),
+             "flops": s["attrs"].get("flops", 0.0),
+             "compile_ms": s["attrs"].get("compile_ms", 0.0)}
+            for s in ops[:3]]
+    return record
 
 
 def stage_overlap(spans: List[dict]) -> float:
@@ -369,3 +419,76 @@ def to_chrome_trace(spans: List[dict]) -> dict:
         })
     return {"traceEvents": events, "displayTimeUnit": "ms",
             "otherData": {"trace_id": spans[0].get("trace_id")}}
+
+
+# -- OTLP JSON-over-HTTP export --------------------------------------------
+
+
+def to_otlp(spans: List[dict], service: str = "trino-tpu") -> dict:
+    """The OTLP/HTTP JSON body (`ExportTraceServiceRequest`): one
+    resourceSpans entry per process, span/trace ids zero-padded to the
+    OTLP widths (16/8 bytes hex), attrs as typed attribute pairs."""
+    by_process: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_process.setdefault(s.get("process") or "?", []).append(s)
+
+    def attr_value(v):
+        if isinstance(v, bool):
+            return {"boolValue": v}
+        if isinstance(v, int):
+            return {"intValue": str(v)}
+        if isinstance(v, float):
+            return {"doubleValue": v}
+        return {"stringValue": str(v)}
+
+    resource_spans = []
+    for process, group in sorted(by_process.items()):
+        otlp_spans = []
+        for s in group:
+            attrs = [{"key": k, "value": attr_value(v)}
+                     for k, v in sorted(s.get("attrs", {}).items())
+                     if isinstance(v, (str, int, float, bool))]
+            span = {
+                "traceId": (s.get("trace_id") or "").rjust(32, "0"),
+                "spanId": (s.get("span_id") or "").rjust(16, "0"),
+                "name": s["name"],
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(int(s["start"] * 1e9)),
+                "endTimeUnixNano": str(int(s["end"] * 1e9)),
+                "attributes": attrs,
+            }
+            if s.get("parent_id"):
+                span["parentSpanId"] = s["parent_id"].rjust(16, "0")
+            otlp_spans.append(span)
+        resource_spans.append({
+            "resource": {"attributes": [
+                {"key": "service.name",
+                 "value": {"stringValue": f"{service}:{process}"}}]},
+            "scopeSpans": [{"scope": {"name": "trino-tpu"},
+                            "spans": otlp_spans}],
+        })
+    return {"resourceSpans": resource_spans}
+
+
+def export_otlp(endpoint: str, spans: List[dict],
+                timeout: float = 2.0) -> bool:
+    """Best-effort POST of the finished span tree to an OTLP/HTTP
+    collector (``tracing_otlp_endpoint``).  Returns True on a 2xx ack;
+    every failure — bad endpoint, refused connection, non-2xx — is
+    swallowed (an observability export must never fail or stall a
+    query; the reference exporter contract)."""
+    if not endpoint or not spans:
+        return False
+    import json as _json
+    import urllib.request
+
+    try:
+        body = _json.dumps(to_otlp(spans)).encode()
+        req = urllib.request.Request(
+            endpoint, data=body,
+            headers={"Content-Type": "application/json"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return 200 <= resp.status < 300
+    except Exception:
+        return False
