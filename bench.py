@@ -70,7 +70,11 @@ reporting p50/p99 + queries/sec, QPS_RESULT line, rc=10 unless the
 cached phase shows plan-cache hits, zero retraces on a repeat
 statement, bounded _QueryState growth, and >= 1.5x the uncached QPS;
 the committed qps_speedup:<schema> baseline is ratcheted — absolute
-qps:<schema> is reported, not gated, being ~2x host-noisy). The
+qps:<schema> is reported, not gated, being ~2x host-noisy);
+BENCH_ROLE=hbo (history-based-statistics report: tiny q1+q3 twice
+with recording, hbo_qerror_p50/p90 metric lines [ratchet-ready for
+the next baseline commit] + the lying-connector matmul-flip witness,
+HBO_RESULT line, rc=13 when the flip or byte-equality fails). The
 parent runs the qlint static
 analyzer as a pre-flight before spawning any child (rc=8 on
 non-baselined findings: retrace-hazardous code must not burn the TPU
@@ -798,6 +802,101 @@ def _trace_smoke() -> dict:
     return out
 
 
+def _hbo_smoke() -> dict:
+    """BENCH_ROLE=hbo: qlint-pre-flighted history-based-statistics
+    report.  Part A runs the tiny TPC-H suite (q1 + q3) twice through
+    the local engine with HBO recording, then emits the misestimate
+    distribution as ``hbo_qerror_p50`` / ``hbo_qerror_p90`` metric
+    lines (ratchet-ready: once a baseline commits, a optimizer change
+    that degrades estimate quality shows up as a quantile jump).
+    Part B is the closed-loop witness: a join whose connector
+    statistics lie by 7 orders of magnitude must flip to the matmul
+    strategy on its second run via recorded history, byte-equal.
+    rc=13 when the flip or the equality fails."""
+    _qlint_preflight()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.connectors.spi import (ColumnStatistics,
+                                          TableStatistics)
+    from trino_tpu.connectors.tpch import TpchConnector
+    from trino_tpu.resources.tpch_queries import TPCH_QUERIES
+    from trino_tpu.runner import LocalQueryRunner
+    from trino_tpu.sql.analyzer import Session
+    from trino_tpu.telemetry import stats_store
+
+    t0 = time.time()
+    stats_store.store().clear()
+    tiny = LocalQueryRunner({"tpch": TpchConnector(page_rows=2048)},
+                            Session(catalog="tpch", schema="tiny"))
+    for _run in range(2):
+        for q in (1, 3):
+            tiny.execute(TPCH_QUERIES[q])
+    p50 = stats_store.store().qerror_quantile(0.5) or 0.0
+    p90 = stats_store.store().qerror_quantile(0.9) or 0.0
+    counters = stats_store.store().counters()
+
+    # Part B: the flip (the lying-statistics connector of the e2e test)
+    class _LyingMetadata:
+        def __init__(self, inner, lies):
+            self._inner = inner
+            self._lies = lies
+
+        def get_statistics(self, table):
+            return self._lies.get((table.schema, table.table)) \
+                or self._inner.get_statistics(table)
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    class _Lying(MemoryConnector):
+        lies = {
+            ("default", "dim"): TableStatistics(
+                row_count=50_000_000.0,
+                columns={"k": ColumnStatistics(
+                    distinct_count=16.0, min_value=0, max_value=127)}),
+            ("default", "fact"): TableStatistics(
+                row_count=500_000_000.0),
+        }
+
+        def metadata(self):
+            return _LyingMetadata(super().metadata(), self.lies)
+
+    r = LocalQueryRunner({"memory": _Lying()},
+                         Session(catalog="memory", schema="default"))
+    r.execute("create table fact (fk bigint, amt bigint)")
+    r.execute("create table dim (k bigint, name bigint)")
+    r.execute("insert into fact values (1, 10), (2, 20), (3, 30)")
+    r.execute("insert into dim values (1, 100), (2, 200), (3, 300)")
+    sql = ("select f.fk, d.name from fact f join dim d on f.fk = d.k "
+           "order by f.fk")
+    first = r.execute(sql)
+    flipped = "strategy=matmul" in r.explain(sql)
+    second = r.execute(sql)
+    out = {
+        "ok": bool(flipped and second.rows == first.rows
+                   and counters["records"] >= 4),
+        "qerror_p50": p50, "qerror_p90": p90,
+        "records": counters["records"],
+        "nodes": counters["nodes"],
+        "flipped": flipped,
+        "byte_equal": second.rows == first.rows,
+        "wall_s": round(time.time() - t0, 2),
+    }
+    print(json.dumps({"metric": "hbo_qerror_p50", "value": p50,
+                      "unit": "qerror", "vs_baseline": 0.0}),
+          flush=True)
+    print(json.dumps({"metric": "hbo_qerror_p90", "value": p90,
+                      "unit": "qerror", "vs_baseline": 0.0}),
+          flush=True)
+    print("HBO_RESULT " + json.dumps(out), flush=True)
+    if not out["ok"]:
+        raise SystemExit(13)
+    return out
+
+
 def _qps_smoke():
     """BENCH_ROLE=qps: concurrent multi-tenant throughput over the REAL
     HTTP protocol surface — N client threads POST /v1/statement and
@@ -1317,5 +1416,7 @@ if __name__ == "__main__":
         _trace_smoke()
     elif os.environ.get("BENCH_ROLE") == "qps":
         _qps_smoke()
+    elif os.environ.get("BENCH_ROLE") == "hbo":
+        _hbo_smoke()
     else:
         main()

@@ -667,6 +667,31 @@ def test_gate_passes_are_not_blind_on_the_real_repo(repo_findings):
         assert kernel in profiled, kernel
 
 
+def test_hbo_record_path_indexed_and_outside_jit(repo_findings):
+    """History-based statistics (round 13): the stats-store write path
+    must be VISIBLE to the index (not blind — a renamed record method
+    would silently stop the check meaning anything) and every caller
+    of it must be OUTSIDE the jit-reachable set: a store write that
+    migrated inside traced code would fire once per compile instead of
+    once per query, freezing history at trace-time values."""
+    from trino_tpu.analysis.trace_purity import (jit_reachable,
+                                                 recording_sites)
+    index, _ = repo_findings
+    sites = recording_sites(index)
+    callers = {fid for fids in sites.values() for fid in fids}
+    # the HboContext record facade calls record_query; the runners
+    # call record/record_actuals — all must be indexed
+    assert any("record_query" in chain for chain in sites), sites
+    assert any("record_actuals" in chain for chain in sites), sites
+    assert any(fid.startswith("trino_tpu.telemetry.stats_store:")
+               for fid in callers), sorted(callers)
+    reached = jit_reachable(index)
+    inside = callers & reached
+    assert not inside, (
+        "stats-store write path reachable from jit-traced code: "
+        + ", ".join(sorted(inside)))
+
+
 def test_cli_runs_clean_and_json(tmp_path):
     """`python -m trino_tpu.analysis` end to end: rc 0 on the clean
     tree, JSON shape, and rc 1 + stale reporting on a bad baseline."""

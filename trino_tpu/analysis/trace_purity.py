@@ -99,6 +99,50 @@ def profiled_entries(index: ProjectIndex) -> Dict[str, List[str]]:
     return out
 
 
+def recording_sites(index: ProjectIndex) -> Dict[str, List[str]]:
+    """Call sites of the history-based-statistics write path
+    (``record_query`` / ``record_actuals`` on the runtime stats store),
+    keyed by called chain with the calling function ids as values —
+    the not-blind witness that actuals recording exists in the index
+    AND (asserted in tests) stays outside every jit-reachable function:
+    a store write that migrated inside traced code would fire once per
+    compile instead of once per query, silently freezing history."""
+    out: Dict[str, List[str]] = {}
+    for mod_name in sorted(index.modules):
+        mod = index.modules[mod_name]
+        for qual in sorted(mod.functions):
+            info = mod.functions[qual]
+            for call in info.calls:
+                last = call.chain.split(".")[-1]
+                if last in ("record_query", "record_actuals"):
+                    out.setdefault(call.chain, []).append(info.id)
+    return out
+
+
+def jit_reachable(index: ProjectIndex) -> Set[str]:
+    """Every function id reachable from a staged-out entry point over
+    resolved call edges — the set the trace-purity findings walk, and
+    the set the stats-store write path must stay OUT of."""
+    entries = jit_entries(index)
+    reached: Set[str] = set()
+    for fid in sorted(entries):
+        stack = [fid]
+        while stack:
+            cur = stack.pop()
+            if cur in reached:
+                continue
+            reached.add(cur)
+            func = index.functions.get(cur)
+            if func is None:
+                continue
+            for call in func.calls:
+                if call.chain in _ALLOWED_CALLS:
+                    continue
+                if call.target and call.target in index.functions:
+                    stack.append(call.target)
+    return reached
+
+
 def jit_entries(index: ProjectIndex) -> Dict[str, EntryInfo]:
     """Every staged-out function in the project, keyed by function id.
     Shared with the recompile pass (traced-branch detection needs the

@@ -240,6 +240,7 @@ class PlanCache:
         self.shape_hits = 0
         self.invalidations = 0
         self.evictions = 0
+        self.hbo_invalidations = 0
 
     def lookup(self, key):
         shape, snapshot_fp = key[0], key[3]
@@ -271,6 +272,21 @@ class PlanCache:
                 self._shape_snap = {s: v for s, v
                                     in self._shape_snap.items()
                                     if s in live}
+
+    def invalidate_shape(self, shape) -> int:
+        """Drop every cached plan of one statement shape: history-based
+        statistics learned a MATERIALLY different cardinality for a
+        decision node, so plans optimized from the old estimates must
+        re-plan against history on their next submission (the HBO
+        analog of a snapshot bump — same loud-miss philosophy)."""
+        with self._lock:
+            doomed = [k for k in self._entries if k[0] == shape]
+            for k in doomed:
+                del self._entries[k]
+            if doomed:
+                self._shape_snap.pop(shape, None)
+                self.hbo_invalidations += len(doomed)
+            return len(doomed)
 
     def __len__(self):
         with self._lock:
@@ -430,6 +446,7 @@ class QueryCache:
             "plan_misses": self.plans.misses,
             "plan_shape_hits": self.plans.shape_hits,
             "plan_invalidations": self.plans.invalidations,
+            "plan_hbo_invalidations": self.plans.hbo_invalidations,
             "plan_evictions": self.plans.evictions,
             "plan_entries": len(self.plans),
             "result_hits": self.results.hits,
@@ -450,11 +467,13 @@ class QueryCache:
         c = self.counters()
         pc = reg.counter("trino_plan_cache_total",
                          "Plan-cache lookups by outcome (hit|miss|"
-                         "shape_hit|invalidation|eviction)")
+                         "shape_hit|invalidation|hbo_invalidation|"
+                         "eviction)")
         pc.inc(c["plan_hits"], outcome="hit")
         pc.inc(c["plan_misses"], outcome="miss")
         pc.inc(c["plan_shape_hits"], outcome="shape_hit")
         pc.inc(c["plan_invalidations"], outcome="invalidation")
+        pc.inc(c["plan_hbo_invalidations"], outcome="hbo_invalidation")
         pc.inc(c["plan_evictions"], outcome="eviction")
         reg.gauge("trino_plan_cache_entries",
                   "Plan-cache resident entries").set(c["plan_entries"])

@@ -61,6 +61,12 @@ RUNTIME_TABLES = {
         ("execute_ms", T.DOUBLE), ("flops", T.DOUBLE),
         ("bytes_accessed", T.DOUBLE), ("output_bytes", T.BIGINT),
         ("temp_bytes", T.BIGINT), ("code_bytes", T.BIGINT)),
+    "plan_stats": (
+        ("statement", T.VARCHAR), ("node", T.VARCHAR),
+        ("name", T.VARCHAR), ("runs", T.BIGINT),
+        ("rows", T.DOUBLE), ("bytes", T.DOUBLE),
+        ("wall_ms", T.DOUBLE), ("flops", T.DOUBLE),
+        ("peak_memory_bytes", T.DOUBLE)),
 }
 
 
@@ -139,6 +145,8 @@ class SystemConnector(Connector):
                 return self._task_rows()
             if table == "kernels":
                 return self._kernel_rows()
+            if table == "plan_stats":
+                return self._plan_stats_rows()
             return self._metric_rows()
         except Exception:
             # introspection must never fail a query over it; a torn
@@ -167,8 +175,8 @@ class SystemConnector(Connector):
     @staticmethod
     def _slow_text(slow) -> Optional[str]:
         """Compact rendering of a slow-query record: critical path +
-        top cost operators, one cell (the full dict stays on the
-        event)."""
+        top cost operators + the worst-misestimated plan node, one
+        cell (the full dict stays on the event)."""
         if not slow:
             return None
         parts = [f"wall={slow.get('wall_ms', 0)}ms"]
@@ -180,7 +188,25 @@ class SystemConnector(Connector):
         if top:
             parts.append("top=" + ", ".join(
                 f"{o['name']} {o['busy_ms']}ms" for o in top))
+        worst = slow.get("worst_misestimate")
+        if worst:
+            parts.append(
+                f"misest={worst['name']} est {worst['est_rows']} "
+                f"actual {worst['actual_rows']} q={worst['qerror']}")
         return "; ".join(parts)
+
+    @staticmethod
+    def _plan_stats_rows() -> List[tuple]:
+        from ..telemetry import stats_store
+
+        rows = []
+        for e in stats_store.store().snapshot():
+            rows.append((e["statement"], e["fp"], e["name"],
+                         e["runs"], round(e["rows"], 2),
+                         round(e["bytes"], 2), round(e["wall_ms"], 3),
+                         round(e["flops"], 2),
+                         round(e["peak_bytes"], 2)))
+        return rows
 
     def _kernel_rows(self) -> List[tuple]:
         from ..telemetry import profiler

@@ -48,6 +48,10 @@ class OperatorStats:
     #: ``op.metrics()`` once the driver finishes — the OperatorStats
     #: analog of the reference's per-operator Metrics map
     metrics: Optional[dict] = None
+    #: canonical plan-node fingerprint this operator realizes (set by
+    #: the local planner when history-based statistics are recording;
+    #: telemetry.stats_store keys actuals by it) — None outside HBO
+    node_fp: Optional[str] = None
 
     def line(self) -> str:
         ms = self.wall_ns / 1e6
@@ -100,7 +104,9 @@ class Driver:
         #: tasks only park on blocked tokens after a no-progress quantum
         self.last_moved = False
         self.stats: List[OperatorStats] = [
-            OperatorStats(type(op).__name__) for op in operators]
+            OperatorStats(type(op).__name__,
+                          node_fp=getattr(op, "_hbo_fp", None))
+            for op in operators]
         #: (epoch seconds, perf_counter_ns) at driver creation: converts
         #: the stats' first_ns/last_ns to wall-clock span timestamps
         self.epoch_anchor = (time.time(), time.perf_counter_ns()) \
@@ -224,6 +230,12 @@ class Driver:
                 got = m()
                 if got:
                     st.metrics = dict(got)
+            # per-operator memory high-water mark (the context's peak
+            # survives close()) — history-based statistics record it
+            ctx = getattr(op, "_ctx", None)
+            peak = getattr(ctx, "peak", 0) if ctx is not None else 0
+            if peak:
+                st.metrics = dict(st.metrics or {}, peak_bytes=peak)
 
     def blocked_tokens(self) -> List:
         """Listen tokens of currently-blocked operators. Meaningful

@@ -133,7 +133,7 @@ class LocalExecutionPlanner:
                  adaptive_partial_min_rows: int = ADAPTIVE_MIN_ROWS,
                  adaptive_partial_buckets: int = ADAPTIVE_KEY_BUCKETS,
                  matmul_max_key_range: int = 1024,
-                 processor_cache=None, progress=None):
+                 processor_cache=None, progress=None, hbo=None):
         self.metadata = metadata
         self.desired_splits = desired_splits
         self.task_id = task_id
@@ -168,6 +168,12 @@ class LocalExecutionPlanner:
         #: live progress tracker (telemetry.progress.QueryProgress):
         #: table scans feed rows_scanned, the plan feeds task counts
         self.progress = progress
+        #: history-based statistics binding
+        #: (telemetry.stats_store.HboContext): when set, every plan
+        #: node's realizing operator is tagged with its canonical
+        #: fingerprint (actuals recording) and partial aggregations
+        #: seed their adaptive verdicts from recorded history
+        self.hbo = hbo
         self.pipelines: List[PhysicalPipeline] = []
         # scan-node id -> [(channel, DynamicFilter)] attachments
         self._scan_dfs: Dict[int, List] = {}
@@ -222,7 +228,15 @@ class LocalExecutionPlanner:
             raise TrinoError(
                 f"no local planning for {type(node).__name__}",
                 "NOT_SUPPORTED")
-        return m(node)
+        out = m(node)
+        if self.hbo is not None and out[0]:
+            # the tail operator realizes this node's output: tag it
+            # with the canonical fingerprint so the driver's stats can
+            # be keyed back to the plan node (a node that adds no
+            # operator re-tags its child's tail — same output stream,
+            # so the actual is identical either way)
+            out[0][-1]._hbo_fp = self.hbo.fp(node)
+        return out
 
     def _v_TableScanNode(self, node: TableScanNode):
         conn = self.metadata.connectors[node.catalog]
@@ -421,6 +435,13 @@ class LocalExecutionPlanner:
                 types_ = [types_[c] for c in want]
                 layout = {s.name: i for i, s in enumerate(in_syms)}
                 group_channels = list(range(len(node.group_keys)))
+        seed = None
+        if self.hbo is not None and node.step == "partial":
+            # seed the adaptive partial-agg verdict from recorded
+            # history: a repeat statement skips the observation window
+            # and lands directly on the per-key-range decision its
+            # last runs converged to (results unchanged either way)
+            seed = self.hbo.adaptive_seed(self.hbo.fp(node))
         op = HashAggregationOperator(
             types_, group_channels, aggs, step=node.step,
             memory_context=self._mem_ctx("agg"),
@@ -428,7 +449,8 @@ class LocalExecutionPlanner:
             adaptive_partial=self.adaptive_partial_agg,
             adaptive_ratio=self.adaptive_partial_ratio,
             adaptive_min_rows=self.adaptive_partial_min_rows,
-            adaptive_key_buckets=self.adaptive_partial_buckets)
+            adaptive_key_buckets=self.adaptive_partial_buckets,
+            adaptive_seed=seed)
         ops.append(op)
         new_layout = {}
         out_types = []

@@ -488,6 +488,8 @@ class OperatorMemoryContext:
         self.name = name
         self.lock = threading.RLock()
         self.reserved = 0
+        self.peak = 0               # high-water mark (survives close();
+        #                             history-based stats record it)
         self.revocable = 0          # portion of reserved that revoke can free
         self._revoke_cb: Optional[Callable[[], int]] = None
 
@@ -654,6 +656,7 @@ class QueryMemoryPool:
     def _admit_locked(self, ctx, nbytes, revocable):
         self.reserved += nbytes
         ctx.reserved += nbytes
+        ctx.peak = max(ctx.peak, ctx.reserved)
         if revocable:
             ctx.revocable += nbytes
         self.peak_bytes = max(self.peak_bytes, self.reserved)

@@ -124,6 +124,11 @@ class QueryStatsTree:
     #: task/operator spans piggybacked on task responses — the timeline
     #: the Chrome-trace export and the Trace: line render
     trace: Optional[List[dict]] = None
+    #: history-based statistics: node-fingerprint -> estimated rows
+    #: (as planned, history consulted) so render() can print per-node
+    #: Q-error beside the actual, plus the worst-misestimate summary
+    estimates: Optional[Dict[str, float]] = None
+    worst_misestimate: Optional[Dict] = None
 
     def to_dict(self) -> dict:
         return {
@@ -228,7 +233,8 @@ class QueryStatsTree:
                                                flops=o.flops,
                                                device_bytes=o.device_bytes,
                                                compile_ms=o.compile_ms,
-                                               metrics=o.metrics)
+                                               metrics=o.metrics,
+                                               node_fp=o.node_fp)
                     else:
                         a.output_rows += o.output_rows
                         a.output_pages += o.output_pages
@@ -243,9 +249,23 @@ class QueryStatsTree:
                         if a.metrics is None:
                             a.metrics = o.metrics
             for i in sorted(agg):
-                lines.append("    " + agg[i].line())
+                line = "    " + agg[i].line()
+                est = (self.estimates or {}).get(agg[i].node_fp) \
+                    if agg[i].node_fp is not None else None
+                if est is not None:
+                    from ..telemetry.stats_store import q_error
+
+                    line += (f" [est {est:.0f} rows, q="
+                             f"{q_error(est, agg[i].output_rows):.2f}]")
+                lines.append(line)
             for t in s.tasks:
                 lines.append(f"    task {t.task_id}: "
                              f"{t.output_rows} rows, "
                              f"{t.wall_ns / 1e6:.1f}ms")
+        if self.worst_misestimate:
+            w = self.worst_misestimate
+            lines.append(
+                f"Worst misestimate: {w['name']} est "
+                f"{w['est_rows']:.0f} rows, actual {w['actual_rows']} "
+                f"(q={w['qerror']:.2f})")
         return lines

@@ -474,7 +474,8 @@ class HashAggregationOperator(Operator):
                  adaptive_partial: bool = True,
                  adaptive_ratio: float = ADAPTIVE_RATIO_THRESHOLD,
                  adaptive_min_rows: int = ADAPTIVE_MIN_ROWS,
-                 adaptive_key_buckets: int = ADAPTIVE_KEY_BUCKETS):
+                 adaptive_key_buckets: int = ADAPTIVE_KEY_BUCKETS,
+                 adaptive_seed: Optional[dict] = None):
         assert step in ("single", "partial", "final")
         self.input_types = list(input_types)
         self.group_channels = list(group_channels)
@@ -517,9 +518,34 @@ class HashAggregationOperator(Operator):
             for (k, _) in _state_plan(a):
                 self._str_state.append(is_str and k in ("min", "max"))
         self._state_dicts: List = [None] * len(self._str_state)
+        #: where the adaptive verdict came from: "observed" (this run's
+        #: window decided) or "hbo" (seeded from recorded history)
+        self._adaptive_source = "observed"
+        if adaptive_seed and self.adaptive_partial:
+            self._apply_adaptive_seed(adaptive_seed)
         self._ctx = memory_context
         if self._ctx is not None:
             self._ctx.set_revoke_callback(self._revoke)
+
+    def _apply_adaptive_seed(self, seed: dict):
+        """Pre-decide the adaptive window from a recorded verdict
+        (history-based statistics): pass-through/aggregate apply
+        directly; a range-split verdict applies only when the bucket
+        count matches the recording (a re-tuned bucket knob re-runs
+        the observation window instead of misapplying a stale mask)."""
+        verdict = seed.get("verdict")
+        if verdict == "passthrough":
+            self.passthrough = True
+        elif verdict == "range-split":
+            mask = seed.get("pass_buckets")
+            if not mask or len(mask) != self.adaptive_key_buckets:
+                return
+            self._pass_buckets = jnp.asarray(
+                np.asarray(mask, dtype=bool))
+        elif verdict != "aggregate":
+            return
+        self._adaptive_decided = True
+        self._adaptive_source = "hbo"
 
     # output layout: group key columns, then state/final columns per agg
     @property
@@ -951,13 +977,29 @@ class HashAggregationOperator(Operator):
         (whole-stream pass-through vs the per-key-range split)."""
         out = {"grouping_paths": {k: v for k, v in
                                   self.path_counts.items() if v}}
+        seeded = " (seeded by hbo)" \
+            if self._adaptive_source == "hbo" else ""
         if self.passthrough:
-            out["adaptive"] = "passthrough"
+            out["adaptive"] = "passthrough" + seeded
         elif self._pass_buckets is not None:
             out["adaptive"] = (
                 f"range-split "
                 f"{int(np.asarray(self._pass_buckets).sum())}/"
-                f"{self.adaptive_key_buckets} buckets pass through")
+                f"{self.adaptive_key_buckets} buckets pass through"
+                + seeded)
+        if self.adaptive_partial and self._adaptive_decided:
+            # the decided verdict, machine-readable: history-based
+            # statistics store it and seed the next run's operator
+            if self.passthrough:
+                verdict: dict = {"verdict": "passthrough"}
+            elif self._pass_buckets is not None:
+                verdict = {"verdict": "range-split",
+                           "pass_buckets": [
+                               int(b) for b in
+                               np.asarray(self._pass_buckets)]}
+            else:
+                verdict = {"verdict": "aggregate"}
+            out["adaptive_verdict"] = verdict
         return out
 
     def is_finished(self) -> bool:

@@ -72,7 +72,8 @@ class DistributedQueryRunner:
 
         return process_families()
 
-    def create_fragments(self, sql_or_stmt) -> List[PlanFragment]:
+    def create_fragments(self, sql_or_stmt,
+                         hbo=None) -> List[PlanFragment]:
         stmt = sql_or_stmt if isinstance(sql_or_stmt, ast.Statement) \
             else parse_statement(sql_or_stmt)
         planner = LogicalPlanner(self.metadata, self.session)
@@ -80,7 +81,7 @@ class DistributedQueryRunner:
         from .. import session_properties as SP
 
         root = optimize(root, self.metadata, planner.allocator,
-                        self.session)
+                        self.session, hbo=hbo)
         trace = getattr(root, "optimizer_trace", None)
         root = add_exchanges(
             root, self.metadata, planner.allocator,
@@ -161,13 +162,26 @@ class DistributedQueryRunner:
                                 "query_profiling_enabled")):
             return self._execute_query_body(stmt, collect_stats)
 
+    def _hbo_context(self, stmt):
+        """History-based-statistics binding (same exclusions as the
+        local runner: hbo_enabled off, non-queries, unversioned
+        catalogs -> None)."""
+        if not SP.value(self.session, "hbo_enabled"):
+            return None
+        from ..telemetry.stats_store import HboContext
+
+        return HboContext.for_statement(
+            stmt, self.session, self.metadata,
+            alpha=SP.value(self.session, "hbo_ewma_alpha"))
+
     def _execute_query_body(self, stmt: ast.QueryStatement,
                             collect_stats: bool = False) -> QueryResult:
         import time as _time
 
         from ..exec.stats import QueryStatsTree, StageStatsTree
 
-        fragments = self.create_fragments(stmt)
+        self._hbo = hbo_ctx = self._hbo_context(stmt)
+        fragments = self.create_fragments(stmt, hbo=hbo_ctx)
         root: OutputNode = self._root
         buffers: Dict[int, OutputBuffer] = {}
         result_pages: List[Page] = []
@@ -178,7 +192,9 @@ class DistributedQueryRunner:
         # a query's global limit over per-node reservations)
         self._memory_pool = pool_from_session(self.session)
         self._stage_stats: List[StageStatsTree] = []
-        self._collect_stats = collect_stats
+        # history recording needs per-operator row counts, so HBO turns
+        # the stats-collecting driver path on even for plain execute()
+        self._collect_stats = collect_stats or hbo_ctx is not None
         t0 = _time.perf_counter()
 
         # tasks run as cooperative generators on the process-wide
@@ -219,6 +235,10 @@ class DistributedQueryRunner:
             stats["streaming_overlap"] = {
                 fid: buf.overlapped for fid, buf in buffers.items()
                 if isinstance(buf, OutputBuffer)}
+        if hbo_ctx is not None:
+            summary = self._hbo_record(hbo_ctx, root, stats)
+            if summary:
+                stats["hbo"] = summary
         if collect_stats:
             # attach each stage's output-boundary exchange skew stats —
             # only now, after every consumer ran: the device collective
@@ -229,12 +249,32 @@ class DistributedQueryRunner:
                 stage = by_stage.get(fid)
                 if stage is not None:
                     stage.exchange = getattr(buf, "stats", None)
-            stats["query_stats"] = QueryStatsTree(
+            tree = QueryStatsTree(
                 stages=self._stage_stats,
                 wall_ms=(_time.perf_counter() - t0) * 1e3,
                 memory=self._memory_pool.stats())
+            if hbo_ctx is not None:
+                tree.estimates = self._hbo_estimates
+                tree.worst_misestimate = (stats.get("hbo") or
+                                          {}).get("worst")
+            stats["query_stats"] = tree
         self._memory_pool.close()  # reap spill files, free residue
         return QueryResult(names, types_, rows, stats=stats)
+
+    def _hbo_record(self, hbo_ctx, root, stats) -> Optional[dict]:
+        """Fold this query's per-node actuals (summed across every
+        stage's tasks) into the history store; stashes the estimate
+        map for EXPLAIN ANALYZE's per-node Q-error rendering."""
+        op_stats = [o for s in self._stage_stats
+                    for t in s.tasks for o in t.operators]
+        est = hbo_ctx.estimates(root, self.metadata)
+        self._hbo_estimates = est[0]
+        scan_rows = sum(o.output_rows for o in op_stats
+                        if o.name == "TableScanOperator")
+        mem = stats.get("memory") or {}
+        return hbo_ctx.record(root, self.metadata, op_stats,
+                              peak_bytes=mem.get("peak_bytes", 0),
+                              scan_rows=scan_rows, estimates=est)
 
     # ----------------------------------------------- streaming mode ----
 
@@ -391,6 +431,7 @@ class DistributedQueryRunner:
             dynamic_filtering=SP.value(
                 self.session, "enable_dynamic_filtering"),
             scan_coalesce=SP.value(self.session, "scan_coalesce_enabled"),
+            hbo=getattr(self, "_hbo", None),
             **grouping_options(self.session.properties))
         collect = getattr(self, "_collect_stats", False)
         task = TaskStatsTree(t)

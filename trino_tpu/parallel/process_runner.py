@@ -159,6 +159,14 @@ class _QueryCtx:
         self.tracer = NULL_TRACER
         self.root_span = NULL_SPAN
         self.attempt_span = NULL_SPAN
+        #: history-based statistics (telemetry.stats_store): the
+        #: per-query HboContext (None = hbo off / unversionable
+        #: statement), the plan root of the winning attempt, and the
+        #: per-task actual lists piggybacked on task responses
+        self.hbo = None
+        self.hbo_root = None
+        self.hbo_actuals: List[list] = []
+        self.hbo_lock = threading.Lock()
 
     def timeout(self, base: Optional[float] = None) -> float:
         """RPC timeout capped by the query deadline (raises
@@ -738,7 +746,8 @@ class ProcessQueryRunner:
             from ..telemetry.tracing import slow_query_record
 
             out["slow_query"] = slow_query_record(
-                stats.get("trace"), wall_s * 1e3, threshold)
+                stats.get("trace"), wall_s * 1e3, threshold,
+                worst_misestimate=(stats.get("hbo") or {}).get("worst"))
         return out
 
     def _explain_analyze(self, stmt,
@@ -847,6 +856,19 @@ class ProcessQueryRunner:
             ctx.session_overrides.update(extra_props)
         if SP.value(self.session, "query_tracing_enabled"):
             ctx.tracer = Tracer(process="coordinator")
+        if SP.value(self.session, "hbo_enabled"):
+            from ..telemetry.stats_store import HboContext
+            from ..telemetry.stats_store import store as _hbo_store
+
+            path = SP.value(self.session, "hbo_store_path")
+            if not hasattr(self, "_hbo_loaded"):
+                self._hbo_loaded = set()
+            if path and path not in self._hbo_loaded:
+                _hbo_store().load(path)
+                self._hbo_loaded.add(path)
+            ctx.hbo = HboContext.for_statement(
+                stmt, self.session, self.metadata,
+                alpha=SP.value(self.session, "hbo_ewma_alpha"))
         try:
             with ctx.tracer.span(
                     "query", statement=type(stmt).__name__) as root:
@@ -894,6 +916,7 @@ class ProcessQueryRunner:
                 if peak:
                     res.stats["memory"] = dict(
                         res.stats.get("memory") or {}, peak_bytes=peak)
+                self._hbo_finish(ctx, res)
                 return res
             except _WorkerLost as e:
                 self._discard_staged(qid)
@@ -946,6 +969,46 @@ class ProcessQueryRunner:
         raise TrinoError(f"query failed after retry: {last_error}",
                          "GENERIC_INTERNAL_ERROR")
 
+    def _collect_local_hbo(self, ctx: _QueryCtx, drivers):
+        """Fold the coordinator-run output stage's fingerprint-tagged
+        operator stats into the query's actuals (the worker shards
+        arrive via task-response piggyback)."""
+        if ctx.hbo is None:
+            return
+        for d in drivers:
+            d.collect_operator_metrics()
+        actuals = ctx.hbo.collect_actuals(
+            [st for d in drivers for st in d.stats])
+        if actuals:
+            with ctx.hbo_lock:
+                ctx.hbo_actuals.append(actuals)
+
+    def _hbo_finish(self, ctx: _QueryCtx, res: QueryResult):
+        """Record the WINNING attempt's merged per-node actuals into
+        the history store (worker piggybacks + coordinator output
+        stage), persist the sidecar when configured, and attach the
+        per-query summary to the result stats."""
+        if ctx.hbo is None or ctx.hbo_root is None:
+            return
+        from ..telemetry.stats_store import merge_actuals
+
+        with ctx.hbo_lock:
+            merged = merge_actuals(ctx.hbo_actuals)
+        if not merged:
+            return
+        scan_rows = sum(a["rows"] for a in merged
+                        if a.get("name") == "TableScanOperator")
+        peak = (res.stats.get("memory") or {}).get("peak_bytes", 0) \
+            if res.stats else 0
+        summary = ctx.hbo.record_actuals(
+            ctx.hbo_root, self.metadata, merged,
+            peak_bytes=peak, scan_rows=scan_rows)
+        if summary:
+            res.stats = dict(res.stats or {}, hbo=summary)
+            path = SP.value(self.session, "hbo_store_path")
+            if path:
+                ctx.hbo.store.save(path)
+
     def _commit_staged(self, query_tasks, qid: str):
         """Apply the successful attempt's staged writes to the
         coordinator catalog, then drop this query's leftovers (failed
@@ -977,7 +1040,7 @@ class ProcessQueryRunner:
             self._task_seq += 1
             return f"q{self._task_seq}a{attempt}"
 
-    def _plan(self, stmt):
+    def _plan(self, stmt, hbo=None):
         from .distributed import DistributedQueryRunner
 
         # reuse the exact planning path of the in-process runner
@@ -985,7 +1048,7 @@ class ProcessQueryRunner:
             self.connectors, self.session, n_workers=self.n_workers,
             desired_splits=self.desired_splits,
             broadcast_threshold=self.broadcast_threshold)
-        fragments = planning.create_fragments(stmt)
+        fragments = planning.create_fragments(stmt, hbo=hbo)
         return fragments, planning._root
 
     def _execute_once(self, stmt, qid: str, ctx: _QueryCtx) -> QueryResult:
@@ -993,7 +1056,19 @@ class ProcessQueryRunner:
                              qid=qid) as attempt_span:
             ctx.attempt_span = attempt_span
             with ctx.tracer.span("plan", parent=attempt_span):
-                fragments, root = self._plan(stmt)
+                fragments, root = self._plan(stmt, hbo=ctx.hbo)
+            with ctx.hbo_lock:
+                # a fresh attempt discards the failed attempt's shards
+                ctx.hbo_root = root
+                ctx.hbo_actuals = []
+            if ctx.hbo is not None:
+                # seed the retry estimator from the statement's
+                # observed peak: a memory failure on the FIRST attempt
+                # of a known shape escalates from history, not hope
+                hint = ctx.hbo.statement_hint()
+                if hint and hint.get("peak_bytes"):
+                    self.cluster_memory.estimator.record_peak(
+                        qid, int(hint["peak_bytes"]))
             # TASK retry requires durable stage outputs, i.e. the
             # spooled barrier shape — the reference's fault-tolerant
             # execution also forgoes streaming pipelining under
@@ -1156,7 +1231,7 @@ class ProcessQueryRunner:
 
         planner = LocalExecutionPlanner(
             self.metadata, self.desired_splits, task_id=0, task_count=1,
-            exchange_reader=exchange_reader,
+            exchange_reader=exchange_reader, hbo=ctx.hbo,
             **grouping_options(self.session.properties))
         abort = threading.Event()
         try:
@@ -1175,11 +1250,13 @@ class ProcessQueryRunner:
                     drivers = []
                     for p in plan.pipelines:
                         d = Driver(p.operators,
-                                   collect_stats=ctx.tracer.enabled)
+                                   collect_stats=ctx.tracer.enabled
+                                   or ctx.hbo is not None)
                         drivers.append(d)
                         run_driver_blocking(d, abort)
                 for d in drivers:
                     add_driver_spans(ctx.tracer, d, task_span)
+                self._collect_local_hbo(ctx, drivers)
             return plan.sink.pages
         except ExchangeConnectionLost as e:
             raise _WorkerLost(f"output stage pull failed: {e}")
@@ -1205,6 +1282,7 @@ class ProcessQueryRunner:
         (streaming tasks outlive their run_task ack, so their spans
         cannot ride the launch response)."""
         want_spans = ctx is not None and ctx.tracer.enabled
+        want_hbo = ctx is not None and ctx.hbo is not None
         by_worker: Dict[tuple, List[str]] = {}
         for addr, task_id in query_tasks:
             by_worker.setdefault(tuple(addr), []).append(task_id)
@@ -1213,14 +1291,35 @@ class ProcessQueryRunner:
             req = {"op": "task_status", "task_ids": ids}
             if want_spans:
                 req["include_spans"] = True
-            try:
-                resp = call(addr, req, timeout=10)
-            except OSError:
-                continue
-            for tid, st in resp.get("statuses", {}).items():
+            # the output stage observes exchange EOF the instant the
+            # last page drains, a beat BEFORE the producer thread
+            # finishes bookkeeping (finished spans, hbo actuals) and
+            # flips its status — poll until every task is terminal
+            # (bounded: producers are already done producing), else an
+            # early read would record under-counted actuals into the
+            # history store
+            statuses: Dict[str, dict] = {}
+            for _ in range(50):
+                try:
+                    resp = call(addr, req, timeout=10)
+                except OSError:
+                    break
+                statuses = resp.get("statuses", {})
+                if not (want_spans or want_hbo) or all(
+                        st.get("status") != "running"
+                        for st in statuses.values()):
+                    break
+                time.sleep(0.02)
+            for tid, st in statuses.items():
                 overlap[tid] = bool(st.get("overlapped"))
                 if want_spans:
                     ctx.tracer.add_finished(st.get("spans"))
+                if want_hbo and st.get("hbo") \
+                        and st.get("status") == "finished":
+                    # streaming tasks outlive their launch ack: their
+                    # actuals ride the same end-of-query poll as spans
+                    with ctx.hbo_lock:
+                        ctx.hbo_actuals.append(st["hbo"])
         return overlap
 
     # ----------------------------------------------- barrier mode ------
@@ -1364,6 +1463,12 @@ class ProcessQueryRunner:
                         query_tasks.append((worker.addr, attempt_id))
                         durations[t] = time.monotonic() - started[t]
                         done[t].set()
+                        if ctx.hbo is not None and resp.get("hbo"):
+                            # only the WINNING attempt's actuals count:
+                            # a superseded speculative duplicate would
+                            # double every node's rows
+                            with ctx.hbo_lock:
+                                ctx.hbo_actuals.append(resp["hbo"])
                         return "win", None
                 # a sibling attempt won (speculation) or the stage
                 # already resolved: free this attempt's buffers
@@ -1586,7 +1691,7 @@ class ProcessQueryRunner:
 
         planner = LocalExecutionPlanner(
             self.metadata, self.desired_splits, task_id=0, task_count=1,
-            exchange_reader=exchange_reader,
+            exchange_reader=exchange_reader, hbo=ctx.hbo,
             **grouping_options(self.session.properties))
         try:
             with ctx.tracer.span(
@@ -1602,9 +1707,12 @@ class ProcessQueryRunner:
                         fragment=frag.fragment_id,
                         task_id="output") as task_span:
                     pages = plan.execute(
-                        collect_stats=ctx.tracer.enabled)
+                        collect_stats=ctx.tracer.enabled
+                        or ctx.hbo is not None)
                 for d in getattr(plan, "drivers", ()):
                     add_driver_spans(ctx.tracer, d, task_span)
+                self._collect_local_hbo(ctx,
+                                        getattr(plan, "drivers", ()))
             return pages
         except RemoteTaskError as e:
             # the taxonomy decides (round-6 satellite: a deterministic

@@ -110,6 +110,12 @@ class _TaskState:
         #: finished trace spans of this task (streaming tasks outlive
         #: the run_task RPC, so spans are collected via task_status)
         self.spans: List[dict] = []
+        #: per-plan-node actuals of this task (fingerprint-keyed dicts;
+        #: telemetry.stats_store shape) — piggybacked on the run_task
+        #: response (barrier) / task_status poll (streaming), so the
+        #: coordinator's history store learns worker actuals with no
+        #: extra RPC
+        self.hbo_actuals: List[dict] = []
         #: armed drop-connection occurrences: result pulls for this task
         #: close mid-frame this many times (FaultSchedule directive)
         self.drop_results = 0
@@ -269,6 +275,10 @@ class WorkerServer:
                     # coordinator collects their finished spans here
                     # (the heartbeat-piggyback pattern)
                     out[tid]["spans"] = list(state.spans)
+                if state.hbo_actuals:
+                    # same piggyback for history actuals: streaming
+                    # tasks report them on the end-of-query poll
+                    out[tid]["hbo"] = list(state.hbo_actuals)
         return out
 
     def metrics_families(self, memory: Optional[dict]) -> list:
@@ -404,13 +414,16 @@ class WorkerServer:
                 self._count_task(True, state.rows)
                 task_span.set("rows", state.rows)
                 task_span.finish()
-                # the attempt's observed peak AND the finished spans
-                # ride the response (piggyback: no extra RPC), so the
-                # coordinator's MemoryEstimator can size a retry and its
-                # tracer can assemble the full tree
+                # the attempt's observed peak, the finished spans, AND
+                # the per-plan-node actuals ride the response
+                # (piggyback: no extra RPC), so the coordinator's
+                # MemoryEstimator can size a retry, its tracer can
+                # assemble the full tree, and its history store learns
+                # worker actuals
                 return {"ok": True, "rows": state.rows,
                         "memory_peak": pool.peak_bytes if pool else 0,
-                        "spans": tracer.finished() or None}
+                        "spans": tracer.finished() or None,
+                        "hbo": state.hbo_actuals or None}
             except Exception as e:
                 state.status = "failed"
                 self._count_task(False)
@@ -692,6 +705,18 @@ class WorkerServer:
 
         session_props = req.get("session", {})
         metadata = Metadata(self.connectors)
+        from .. import session_properties as SP
+
+        hbo_on = SP.prop_value(session_props, "hbo_enabled")
+        hbo_ctx = None
+        if hbo_on:
+            # store-less binding: the worker only TAGS operators with
+            # node fingerprints; actuals ride the task response back to
+            # the coordinator's store (history lookups/seeds are a
+            # coordinator concern — it plans, workers execute)
+            from ..telemetry.stats_store import HboContext
+
+            hbo_ctx = HboContext("", "", None)
         planner = LocalExecutionPlanner(
             metadata, req.get("desired_splits", 8),
             task_id=task_index, task_count=req["task_count"],
@@ -702,7 +727,7 @@ class WorkerServer:
                 "enable_dynamic_filtering", True),
             page_sink_factory=self._sink_factory(req),
             scan_coalesce=session_props.get("scan_coalesce_enabled", True),
-            **grouping_options(session_props))
+            hbo=hbo_ctx, **grouping_options(session_props))
 
         with tracer.span("plan", parent=task_span,
                          task_id=req["task_id"]):
@@ -735,13 +760,15 @@ class WorkerServer:
         # the exec span is the driver-run wall: its operator children's
         # busy time must account for ~all of it (the trace-tree test's
         # attribution invariant); stats collection costs two clock
-        # reads per page move and only runs when tracing is on
+        # reads per page move and only runs when tracing or history
+        # recording wants the per-operator counts
         with tracer.span("exec", parent=task_span,
                          task_id=req["task_id"],
                          span_kind="exec") as exec_span:
             drivers = []
             for p in planner.pipelines:
-                d = Driver(p.operators, collect_stats=tracer.enabled)
+                d = Driver(p.operators,
+                           collect_stats=tracer.enabled or hbo_on)
                 drivers.append(d)
                 if streaming:
                     run_driver_blocking(d, state.abort)
@@ -749,6 +776,11 @@ class WorkerServer:
                     d.run_to_completion()
         for d in drivers:
             add_driver_spans(tracer, d, exec_span)
+        if hbo_ctx is not None:
+            for d in drivers:
+                d.collect_operator_metrics()
+            state.hbo_actuals = hbo_ctx.collect_actuals(
+                [st for d in drivers for st in d.stats])
         spool_dir = req.get("spool_dir")
         if spool_dir:
             # durable publish BEFORE reporting success: a retried

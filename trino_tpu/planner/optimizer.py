@@ -33,12 +33,16 @@ FILTER_SELECTIVITY = 0.33
 
 
 def optimize(root: OutputNode, metadata: Metadata,
-             allocator: SymbolAllocator, session=None) -> OutputNode:
+             allocator: SymbolAllocator, session=None,
+             hbo=None) -> OutputNode:
     """The optimizer pipeline: the memo-based iterative rule engine
     (predicate/limit pushdown, scan negotiation, cost-based join
     reordering — planner/memo.py + planner/rules.py), then the ordered
     column-pruning/cleanup passes (the reference also runs
-    PruneUnreferencedOutputs-style passes outside exploration)."""
+    PruneUnreferencedOutputs-style passes outside exploration).
+    ``hbo`` (telemetry.stats_store.HboContext) feeds recorded runtime
+    actuals into the kernel-strategy cost rules — history beats
+    connector estimates."""
     from .memo import IterativeOptimizer
     from .rules import default_rules
 
@@ -55,7 +59,7 @@ def optimize(root: OutputNode, metadata: Metadata,
     # kernel-strategy annotation runs LAST: the choices must land on
     # the final plan nodes the local planner and EXPLAIN read
     out.optimizer_trace += annotate_kernel_strategies(node, metadata,
-                                                      session)
+                                                      session, hbo=hbo)
     return out
 
 
@@ -280,7 +284,8 @@ def choose_join_strategy(node: "JoinNode", calc, override: str,
         if rs.distinct_count is None or rs.distinct_count > max_range:
             return "sorted-index", ""
         detail = (f"build~{right.row_count:.0f} rows, pool~"
-                  f"{rs.distinct_count:.0f} codes <= {max_range}")
+                  f"{rs.distinct_count:.0f} codes <= {max_range}, "
+                  f"source={right.source}")
         return "matmul", detail
     storage = getattr(t, "storage", None)
     import numpy as _np
@@ -296,22 +301,23 @@ def choose_join_strategy(node: "JoinNode", calc, override: str,
     if key_range > max_range:
         return "sorted-index", ""
     detail = (f"build~{right.row_count:.0f} rows, key range "
-              f"{key_range:.0f} <= {max_range}")
+              f"{key_range:.0f} <= {max_range}, source={right.source}")
     return "matmul", detail
 
 
 def choose_agg_strategy(ndv_estimate: float, n_devices: int = 1,
                         override: str = "AUTOMATIC",
-                        max_table: Optional[int] = None
-                        ) -> Tuple[str, str]:
+                        max_table: Optional[int] = None,
+                        source: str = "observed") -> Tuple[str, str]:
     """('exchange' | 'global-hash', detail).  The global-hash table is
     replicated per device and merged by collective scatter-add, so it
     wins exactly when 2x the group-count bound (load factor <= 0.5)
     stays small — below ``global_hash_agg_max_table`` slots; past that
     the all_to_all of partial groups moves fewer bytes than the table
-    all-reduce.  Shared verbatim by the planner annotation and the
-    mesh runtime (which calls it with stage 1's OBSERVED group
-    count)."""
+    all-reduce.  Shared verbatim by the planner annotation (which
+    passes the estimate's ``source`` — connector stats vs recorded
+    history) and the mesh runtime (which calls it with stage 1's
+    OBSERVED group count, the default source label)."""
     if max_table is None:
         from .. import session_properties as SP
 
@@ -324,18 +330,22 @@ def choose_agg_strategy(ndv_estimate: float, n_devices: int = 1,
     if table <= max_table:
         return "global-hash", (f"~{ndv_estimate:.0f} groups -> table "
                                f"{table} <= {max_table} over "
-                               f"{n_devices} device(s)")
+                               f"{n_devices} device(s), "
+                               f"source={source}")
     return "exchange", (f"~{ndv_estimate:.0f} groups -> table {table} "
-                        f"> {max_table}")
+                        f"> {max_table}, source={source}")
 
 
 def annotate_kernel_strategies(node: PlanNode, metadata: Metadata,
-                               session=None) -> List[tuple]:
+                               session=None, hbo=None) -> List[tuple]:
     """Post-optimization pass: stamp every JoinNode with the probe
     strategy and every grouped AggregationNode with the merge shape the
-    cost model picks from connector stats, honoring the session
-    overrides.  Returns (rule, detail) trace entries for EXPLAIN's
-    provenance block."""
+    cost model picks, honoring the session overrides.  ``hbo`` feeds
+    recorded per-node actuals into the StatsCalculator, so observed
+    build-side cardinality and live group counts beat connector
+    guesses; every node additionally carries ``est_rows``/``est_source``
+    so EXPLAIN can annotate where each estimate came from.  Returns
+    (rule, detail) trace entries for EXPLAIN's provenance block."""
     from .. import session_properties as SP
     from .stats import StatsCalculator
 
@@ -348,12 +358,15 @@ def annotate_kernel_strategies(node: PlanNode, metadata: Metadata,
         join_override = agg_override = "AUTOMATIC"
         max_range = SP.prop_value({}, "matmul_join_max_key_range")
         max_table = SP.prop_value({}, "global_hash_agg_max_table")
-    calc = StatsCalculator(metadata)
+    calc = StatsCalculator(metadata, history=hbo)
     trace: List[tuple] = []
 
     def walk(n: PlanNode):
         for s in n.sources:
             walk(s)
+        if hbo is not None:
+            st = calc.stats(n)
+            n.est_rows, n.est_source = st.row_count, st.source
         if isinstance(n, JoinNode):
             strat, detail = choose_join_strategy(n, calc, join_override,
                                                  max_range)
@@ -370,7 +383,8 @@ def annotate_kernel_strategies(node: PlanNode, metadata: Metadata,
                 n.strategy, n.strategy_detail = "exchange", ""
                 return
             strat, detail = choose_agg_strategy(st.row_count, 1,
-                                                agg_override, max_table)
+                                                agg_override, max_table,
+                                                source=st.source)
             n.strategy, n.strategy_detail = strat, detail
             if strat == "global-hash":
                 trace.append(("GlobalHashAggStrategy", detail))

@@ -552,6 +552,11 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
                       for o in node.orderings))
     elif isinstance(node, OutputNode):
         detail = f" {node.column_names}"
+    # estimate provenance (annotate_kernel_strategies stamps these when
+    # history-based statistics are in play): only hbo-sourced estimates
+    # render, so plans without history keep today's byte-exact text
+    if getattr(node, "est_source", None) == "hbo":
+        detail += f" est~{node.est_rows:.0f} rows [source=hbo]"
     out = f"{pad}- {name}{detail}\n"
     for s in node.sources:
         out += plan_tree_str(s, indent + 1)

@@ -40,11 +40,16 @@ class SymbolStats:
 
 @dataclass
 class PlanStats:
-    """Per-node estimate (reference: cost/PlanNodeStatsEstimate.java)."""
+    """Per-node estimate (reference: cost/PlanNodeStatsEstimate.java).
+    ``source`` names what produced the row count: ``connector``
+    (statistics-derived guesses) or ``hbo`` (recorded runtime history
+    overrode the estimate) — EXPLAIN and the strategy details surface
+    it per estimate."""
 
     row_count: float = DEFAULT_ROWS
     symbols: Dict[str, SymbolStats] = field(default_factory=dict)
     confident: bool = False
+    source: str = "connector"
 
     def symbol(self, name: str) -> SymbolStats:
         return self.symbols.get(name, SymbolStats())
@@ -57,7 +62,7 @@ class PlanStats:
                            if s.distinct_count is None
                            else min(s.distinct_count, max(rows, 1.0)))
                 for n, s in self.symbols.items()}
-        return PlanStats(rows, syms, self.confident)
+        return PlanStats(rows, syms, self.confident, self.source)
 
 
 def _as_float(v) -> Optional[float]:
@@ -73,10 +78,16 @@ def _as_float(v) -> Optional[float]:
 
 
 class StatsCalculator:
-    """Bottom-up estimator with per-node-type rules."""
+    """Bottom-up estimator with per-node-type rules.  ``history`` (a
+    ``telemetry.stats_store.HboContext``) lets recorded runtime actuals
+    beat the connector-derived estimate per node — the decision
+    precedence is history > connector > defaults, and an overridden
+    node reports ``source='hbo'`` with full confidence (an observation
+    beats any guess)."""
 
-    def __init__(self, metadata):
+    def __init__(self, metadata, history=None):
         self.metadata = metadata
+        self.history = history
         # the cached NODE rides in the value: a bare id() key would go
         # stale when a freed node's address is reused (the optimizer
         # builds throwaway candidate JoinNodes in a loop)
@@ -88,6 +99,13 @@ class StatsCalculator:
             return hit[1]
         m = getattr(self, "_s_" + type(node).__name__, None)
         got = m(node) if m is not None else self._default(node)
+        if self.history is not None:
+            observed = self.history.rows_for(node)
+            if observed is not None:
+                # keep the per-symbol detail (ndv/min-max still come
+                # from the connector); history owns the cardinality
+                got = PlanStats(max(observed, 1.0), got.symbols,
+                                True, "hbo")
         self._cache[id(node)] = (node, got)
         return got
 

@@ -72,6 +72,9 @@ class LocalQueryRunner:
 
         self.query_cache = QueryCache(
             self.metadata, result_cache_bytes=result_cache_bytes)
+        #: sidecar paths already loaded into the process-wide history
+        #: store (telemetry.stats_store) — load once per path
+        self._hbo_loaded: set = set()
 
     def _scan_refs(self, root: OutputNode) -> List[tuple]:
         """Every scanned ``(catalog, schema, table, columns)`` of a plan
@@ -109,11 +112,56 @@ class LocalQueryRunner:
         stmt = parse_statement(sql)
         return self.plan_statement(stmt)
 
-    def plan_statement(self, stmt: ast.Statement) -> OutputNode:
+    def plan_statement(self, stmt: ast.Statement,
+                       hbo=None) -> OutputNode:
         planner = LogicalPlanner(self.metadata, self.session)
         root = planner.plan(stmt)
         return optimize(root, self.metadata, planner.allocator,
-                        self.session)
+                        self.session, hbo=hbo)
+
+    def _hbo_context(self, stmt: ast.Statement):
+        """The history-based-statistics binding for one statement, or
+        None (``hbo_enabled=false``, non-query statements, and
+        statements over unversioned catalogs — the same exclusions the
+        plan cache applies).  First use of a configured sidecar path
+        loads it into the process-wide store."""
+        from . import session_properties as SP
+
+        if not SP.value(self.session, "hbo_enabled"):
+            return None
+        from .telemetry.stats_store import HboContext, store
+
+        path = SP.value(self.session, "hbo_store_path")
+        if path and path not in self._hbo_loaded:
+            store().load(path)
+            self._hbo_loaded.add(path)
+        return HboContext.for_statement(
+            stmt, self.session, self.metadata,
+            alpha=SP.value(self.session, "hbo_ewma_alpha"))
+
+    def _hbo_record(self, ctx, shape, root, drivers, memory_stats,
+                    estimates=None) -> Optional[dict]:
+        """Post-execution history recording (host-side, drivers done):
+        fold fingerprint-tagged operator actuals into the store, drop
+        cached plans of the shape when a decision node misestimated
+        materially, and persist the sidecar when configured."""
+        from . import session_properties as SP
+
+        for d in drivers:
+            d.collect_operator_metrics()
+        op_stats = [st for d in drivers for st in d.stats]
+        scan_rows = sum(st.output_rows for st in op_stats
+                        if st.name == "TableScanOperator")
+        summary = ctx.record(
+            root, self.metadata, op_stats,
+            peak_bytes=(memory_stats or {}).get("peak_bytes", 0),
+            scan_rows=scan_rows, estimates=estimates)
+        if summary and summary["material"] and shape is not None:
+            self.query_cache.plans.invalidate_shape(shape)
+        path = SP.value(self.session, "hbo_store_path")
+        if path and summary:
+            ctx.store.save(path)
+        return summary
 
     def explain(self, sql: str) -> str:
         from .planner.optimizer import provenance_lines
@@ -121,7 +169,7 @@ class LocalQueryRunner:
         stmt = parse_statement(sql)
         if isinstance(stmt, ast.Explain):
             stmt = stmt.statement
-        root = self.plan_statement(stmt)
+        root = self.plan_statement(stmt, hbo=self._hbo_context(stmt))
         text = plan_tree_str(root)
         prov = provenance_lines(root)
         return text + ("\n" + "\n".join(prov) if prov else "")
@@ -141,12 +189,37 @@ class LocalQueryRunner:
 
             group = self.resource_groups.select(user)
             # memory-aware admission: the query's budget is its
-            # charge against the group's soft/hard memory limits
-            with group.run(memory_bytes=SP.value(
-                    self.session, "query_max_memory_bytes")):
+            # charge against the group's soft/hard memory limits —
+            # seeded DOWN from the statement's observed peak when
+            # history knows it (a dashboard query that historically
+            # peaks at 50 MB must not hold an 8 GB admission slot)
+            mem = SP.value(self.session, "query_max_memory_bytes")
+            hinted = self._hbo_admission_bytes(sql)
+            if hinted:
+                mem = min(mem, hinted)
+            with group.run(memory_bytes=mem):
                 return self._monitored_execute(sql, user,
                                                progress=progress)
         return self._monitored_execute(sql, user, progress=progress)
+
+    def _hbo_admission_bytes(self, sql: str) -> Optional[int]:
+        """Observed-peak admission hint (2x headroom over the EWMA
+        peak, floored): None when history has nothing for this
+        statement under the current snapshot.  Advisory only — a parse
+        error here surfaces identically on the monitored path."""
+        try:
+            pq = self.query_cache.parse(sql, self.session)
+            if not pq.is_query:
+                return None
+            ctx = self._hbo_context(pq.stmt)
+            if ctx is None:
+                return None
+            hint = ctx.statement_hint()
+        except Exception:
+            return None
+        if not hint or not hint.get("peak_bytes"):
+            return None
+        return max(int(2 * hint["peak_bytes"]), 64 << 20)
 
     def execute_batch(self, sqls: Sequence[str],
                       user: Optional[str] = None) -> List:
@@ -241,9 +314,10 @@ class LocalQueryRunner:
                            res: QueryResult) -> Optional[dict]:
         """The slow-query log record when ``wall_s`` exceeds
         ``slow_query_log_threshold`` (0 = disabled): wall + threshold,
-        the trace critical path when the run carried spans, and the
-        top-3 cost-attributed operators (by busy wall, carrying
-        flops/compile-ms when the profiler recorded them).  Rides the
+        the trace critical path when the run carried spans, the top-3
+        cost-attributed operators, and the worst-Q-error plan node
+        when history-based statistics recorded this run — misestimates
+        surface exactly where slow queries are triaged.  Rides the
         QueryCompletedEvent stats into system.runtime.queries."""
         from . import session_properties as SP
 
@@ -252,8 +326,10 @@ class LocalQueryRunner:
             return None
         from .telemetry.tracing import slow_query_record
 
+        hbo = (res.stats or {}).get("hbo") or {}
         return slow_query_record((res.stats or {}).get("trace"),
-                                 wall_s * 1e3, threshold)
+                                 wall_s * 1e3, threshold,
+                                 worst_misestimate=hbo.get("worst"))
 
     def _execute_sql(self, sql: str, user: Optional[str] = None,
                      progress=None) -> QueryResult:
@@ -269,7 +345,8 @@ class LocalQueryRunner:
                                              verbose=stmt.verbose)
             from .planner.optimizer import provenance_lines
 
-            root = self.plan_statement(stmt.statement)
+            root = self.plan_statement(
+                stmt.statement, hbo=self._hbo_context(stmt.statement))
             lines = plan_tree_str(root).splitlines()
             prov = provenance_lines(root)
             if prov:
@@ -376,11 +453,12 @@ class LocalQueryRunner:
                 return QueryResult(list(names), list(types_),
                                    list(rows),
                                    stats={"result_cache": "hit"})
+        hbo_ctx = self._hbo_context(stmt)
         root = self.query_cache.plans.lookup(key) \
             if key is not None else None
         plan_hit = root is not None
         if root is None:
-            root = self.plan_statement(stmt)
+            root = self.plan_statement(stmt, hbo=hbo_ctx)
             if key is not None:
                 self.query_cache.plans.store(
                     key, root,
@@ -390,16 +468,28 @@ class LocalQueryRunner:
             # rows-based completion estimate from connector statistics
             progress.total_rows = self._scan_rows_estimate(root)
             progress.state = "RUNNING"
+            if progress.total_rows == 0 and hbo_ctx is not None:
+                # statistics-less connectors would report no fraction
+                # forever: fall back to the rows this statement shape
+                # actually scanned on previous runs
+                hint = hbo_ctx.statement_hint()
+                if hint and hint.get("scan_rows"):
+                    progress.total_rows = int(hint["scan_rows"])
+                    progress.estimate_source = "hbo"
         local = self._make_local_planner(
             processor_cache=self.query_cache.processors
-            if plan_caching else None, progress=progress)
+            if plan_caching else None, progress=progress,
+            hbo=hbo_ctx)
         from .telemetry.profiler import profiling
 
         with profiling(SP.value(self.session,
                                 "query_profiling_enabled")):
             try:
                 plan = local.plan(root)
-                pages = plan.execute()
+                # per-node actuals need per-operator row counts: the
+                # stats-collecting driver path runs exactly when HBO
+                # records (off = the byte-identical pre-HBO hot path)
+                pages = plan.execute(collect_stats=hbo_ctx is not None)
                 rows: List[tuple] = []
                 for p in pages:
                     rows.extend(p.to_rows())
@@ -411,6 +501,12 @@ class LocalQueryRunner:
                 local.memory_pool.close()
         if progress is not None:
             progress.state = "FINISHED"
+        if hbo_ctx is not None:
+            summary = self._hbo_record(hbo_ctx, pq.shape, root,
+                                       getattr(plan, "drivers", []),
+                                       stats.get("memory"))
+            if summary:
+                stats["hbo"] = summary
         if local.dynamic_filters:
             stats["dynamic_filters"] = [df.stats()
                                         for df in local.dynamic_filters]
@@ -442,7 +538,8 @@ class LocalQueryRunner:
         return SP.value(self.session, "join_max_expand_lanes")
 
     def _make_local_planner(self, processor_cache=None,
-                            progress=None) -> LocalExecutionPlanner:
+                            progress=None,
+                            hbo=None) -> LocalExecutionPlanner:
         """Session-configured planner: ALL execution paths (execute,
         EXPLAIN ANALYZE, the DELETE rewrite) must honor the same
         session knobs."""
@@ -457,7 +554,7 @@ class LocalQueryRunner:
                                        "enable_dynamic_filtering"),
             scan_coalesce=SP.value(self.session, "scan_coalesce_enabled"),
             processor_cache=processor_cache, progress=progress,
-            **grouping_options(self.session.properties))
+            hbo=hbo, **grouping_options(self.session.properties))
 
     def _scan_rows_estimate(self, root: OutputNode) -> int:
         """Connector-statistics row estimate summed over the plan's
@@ -482,14 +579,18 @@ class LocalQueryRunner:
         planprinter/PlanPrinter.java).  VERBOSE additionally enables
         the compiled-program profiler for the run, so operator lines
         carry flops / bytes / compile-ms and a Kernels summary renders
-        the programs this query compiled vs reused."""
+        the programs this query compiled vs reused.  With history-based
+        statistics on, every fingerprinted operator line carries its
+        estimate and Q-error, a worst-misestimate summary line renders,
+        and the run's actuals fold into the history store."""
         import time as _time
 
         from .telemetry import profiler
 
-        root = self.plan_statement(stmt)
+        hbo_ctx = self._hbo_context(stmt)
+        root = self.plan_statement(stmt, hbo=hbo_ctx)
         self._check_table_access(stmt, root)  # ANALYZE executes the query
-        local = self._make_local_planner()
+        local = self._make_local_planner(hbo=hbo_ctx)
         pool = local.memory_pool
         before = profiler.totals() if verbose else None
         with profiler.profiling(verbose):
@@ -502,6 +603,20 @@ class LocalQueryRunner:
             finally:
                 pool.close()
         out_rows = sum(p.num_rows for p in pages)
+        est_map: Dict[str, float] = {}
+        summary = None
+        if hbo_ctx is not None:
+            # estimates BEFORE recording: the Q-errors rendered below
+            # must be the ones THIS run's planning actually used (the
+            # same walk feeds record(), so it isn't paid twice)
+            est = hbo_ctx.estimates(root, self.metadata)
+            est_map = est[0]
+            from .cache import normalize_statement
+
+            shape = normalize_statement(stmt)[0] \
+                if isinstance(stmt, ast.QueryStatement) else None
+            summary = self._hbo_record(hbo_ctx, shape, root,
+                                       plan.drivers, m, estimates=est)
         lines = plan_tree_str(root).splitlines()
         lines.append("")
         lines.append(f"Query: {wall * 1e3:.1f}ms, {out_rows} rows")
@@ -515,7 +630,21 @@ class LocalQueryRunner:
             d.collect_operator_metrics()
             lines.append(f"Pipeline {i}:")
             for st in d.stats:
-                lines.append("  " + st.line())
+                line = "  " + st.line()
+                est = est_map.get(st.node_fp) \
+                    if st.node_fp is not None else None
+                if est is not None:
+                    from .telemetry.stats_store import q_error
+
+                    line += (f" [est {est:.0f} rows, "
+                             f"q={q_error(est, st.output_rows):.2f}]")
+                lines.append(line)
+        if summary and summary.get("worst"):
+            w = summary["worst"]
+            lines.append(
+                f"Worst misestimate: {w['name']} est "
+                f"{w['est_rows']:.0f} rows, actual {w['actual_rows']} "
+                f"(q={w['qerror']:.2f})")
         if verbose:
             lines.append(_kernels_line(before, profiler.totals()))
         return QueryResult(["Query Plan"], [T.VARCHAR],

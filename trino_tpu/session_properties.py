@@ -65,6 +65,12 @@ query_profiling_enabled                    runner.py,
 slow_query_log_threshold                   runner.py,
                                            parallel/process_runner.py
 tracing_otlp_endpoint                      parallel/process_runner.py
+hbo_enabled                                runner.py,
+                                           parallel/distributed.py,
+                                           parallel/process_runner.py,
+                                           parallel/worker.py
+hbo_store_path, hbo_ewma_alpha             runner.py,
+                                           parallel/process_runner.py
 ========================================== ===========================
 """
 
@@ -406,6 +412,30 @@ register(SessionProperty(
     "set, the finished span tree of every traced query exports "
     "best-effort as OTLP JSON; empty = no export, and failures are "
     "silently swallowed (an exporter must never fail a query)"))
+register(SessionProperty(
+    "hbo_enabled", "boolean", True,
+    "History-based statistics (telemetry.stats_store): record per-"
+    "plan-node actuals (rows/bytes/peak memory/wall/flops) after every "
+    "executed query, keyed by (statement shape, canonical node "
+    "fingerprint), and let recorded history beat connector estimates "
+    "in the join/agg strategy rules, adaptive partial-agg seeding, "
+    "admission sizing, and progress fallback. EXPLAIN annotates "
+    "source=hbo per overridden estimate; a material misestimate on a "
+    "decision node invalidates cached plans of the shape so the next "
+    "run re-plans from history. Off = exactly the pre-HBO engine: no "
+    "store writes, no per-page stats collection"))
+register(SessionProperty(
+    "hbo_store_path", "varchar", "",
+    "JSON sidecar path for the history store: loaded before the first "
+    "HBO-planned query of a process, re-saved after every recording, "
+    "so history survives restarts (atomic tmp+rename writes; a corrupt "
+    "sidecar warns loudly and starts empty). Empty = in-memory only"))
+register(SessionProperty(
+    "hbo_ewma_alpha", "double", 0.4,
+    "EWMA weight of the newest observation when merging per-node "
+    "actuals across runs (the first run seeds exactly); smaller = "
+    "smoother history, larger = faster adaptation to drift",
+    lambda v: 0 < v <= 1))
 register(SessionProperty(
     "device_exchange_sizing", "varchar", "history",
     "How the device collective picks its all_to_all lane capacity "

@@ -302,7 +302,12 @@ def process_families(tasks: Optional[int] = None,
         g.set(dm["live_bytes"], kind="live")
         g.set(dm["peak_bytes"], kind="peak")
         g.set(dm["limit_bytes"], kind="limit")
-    return reg.collect()
+    from . import stats_store
+
+    # trino_hbo_* rides the same process surface (and the heartbeat
+    # piggyback) as the profiler: store size, lookup outcomes, and the
+    # misestimate histogram — empty until the first HBO-recorded query
+    return reg.collect() + stats_store.store().families()
 
 
 class ClusterMetrics:

@@ -31,13 +31,19 @@ class QueryProgress:
     scan hot path must not take a lock per page."""
 
     __slots__ = ("query_id", "total_rows", "rows_scanned", "tasks_total",
-                 "tasks_done", "tasks_running", "started", "state")
+                 "tasks_done", "tasks_running", "started", "state",
+                 "estimate_source")
 
     def __init__(self, query_id: str, total_rows: int = 0):
         self.query_id = query_id
         #: connector-statistics estimate of rows this query will scan
-        #: (0 = unknown: fraction stays 0 until terminal)
+        #: (0 = unknown: fraction stays 0 until terminal).  When
+        #: connector statistics are absent the runner falls back to
+        #: history-based actuals (telemetry.stats_store) and flips
+        #: ``estimate_source`` to "hbo" — a statistics-less connector
+        #: no longer means a progress bar stuck at zero
         self.total_rows = int(total_rows)
+        self.estimate_source = "connector"
         self.rows_scanned = 0
         self.tasks_total = 0
         self.tasks_done = 0
@@ -68,6 +74,7 @@ class QueryProgress:
             "fraction": round(self.fraction(), 4),
             "rows_scanned": self.rows_scanned,
             "total_rows_estimate": self.total_rows,
+            "estimate_source": self.estimate_source,
             "tasks": {"total": self.tasks_total,
                       "running": self.tasks_running,
                       "done": self.tasks_done},

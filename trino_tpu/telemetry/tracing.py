@@ -299,15 +299,19 @@ def trace_line(spans: List[dict]) -> Optional[str]:
 
 
 def slow_query_record(spans: Optional[List[dict]], wall_ms: float,
-                      threshold_s: float) -> dict:
+                      threshold_s: float,
+                      worst_misestimate: Optional[dict] = None) -> dict:
     """The structured slow-query log record
     (``slow_query_log_threshold``): wall + threshold, the trace
-    critical path, and the top-3 cost-attributed operators (by busy
-    wall, carrying flops/compile-ms when the profiler recorded them).
-    One builder shared by every runner so the system.runtime.queries
-    renderings cannot drift."""
+    critical path, the top-3 cost-attributed operators (by busy wall,
+    carrying flops/compile-ms when the profiler recorded them), and —
+    when history-based statistics recorded the run — the worst-Q-error
+    plan node (name, estimate, actual): misestimates surface exactly
+    where slow queries are triaged.  One builder shared by every
+    runner so the system.runtime.queries renderings cannot drift."""
     record = {"wall_ms": round(wall_ms, 2), "threshold_s": threshold_s,
-              "critical_path": None, "top_operators": []}
+              "critical_path": None, "top_operators": [],
+              "worst_misestimate": worst_misestimate}
     if spans:
         record["critical_path"] = [
             {"name": s["name"],
