@@ -1230,6 +1230,14 @@ def _qlint_preflight():
     qlint = _load_qlint()
     assert "jax" not in sys.modules, \
         "qlint pre-flight must not import jax in the bench parent"
+    # all eight passes must be registered (round 14 added
+    # cache-coherence + resource-lifecycle): a refactor that dropped a
+    # pass from the registry would silently weaken this gate
+    missing = {"trace-purity", "lock-order", "recompile",
+               "session-props", "taxonomy", "blocked-protocol",
+               "cache-coherence",
+               "resource-lifecycle"} - set(qlint.PASSES)
+    assert not missing, f"qlint passes missing from registry: {missing}"
 
     package = os.path.join(REPO, "trino_tpu")
     findings = qlint.run_passes(qlint.ProjectIndex.from_package(package))

@@ -149,7 +149,7 @@ def test_trace_purity_pragma_opt_out():
 
         @jax.jit
         def kernel(x):
-            mode = os.environ.get("MODE", "")  # qlint: ignore[trace-purity]
+            mode = os.environ.get("MODE", "")  # qlint: ignore[trace-purity] trace-static knob
             return x
     """})
     assert run_passes(idx, ["trace-purity"]) == []
@@ -276,26 +276,19 @@ def test_lock_order_rpc_under_lock():
 
 # -- recompile -----------------------------------------------------------
 
-def test_recompile_unhashable_arg_and_session_read():
+def test_recompile_unhashable_arg():
     idx = index_of(**{"pkg.exch": """
         from functools import lru_cache
-        from .. import session_properties as SP
 
         @lru_cache(maxsize=8)
         def build_program(mesh, opts):
-            min_c = SP.prop_value({}, "rebalance_min_collectives")
-            return (mesh, opts, min_c)
+            return (mesh, opts)
 
         def run(mesh):
             return build_program(mesh, {"sizing": "exact"})
     """})
     found = run_passes(idx, ["recompile"])
-    got = rules(found)
-    assert ("recompile", "unhashable-arg") in got
-    assert ("recompile", "cached-builder-reads-session") in got
-    session = [f for f in found
-               if f.rule == "cached-builder-reads-session"]
-    assert "rebalance_min_collectives" in session[0].message
+    assert ("recompile", "unhashable-arg") in rules(found)
 
 
 def test_recompile_traced_branch():
@@ -457,11 +450,36 @@ def test_taxonomy_scoped_to_parallel_and_pragma():
         "pkg.parallel.fault": "def g():\n    raise RuntimeError('y')\n",
         "pkg.parallel.chaos": """
             def inject(task_id):
-                raise RuntimeError(  # qlint: ignore[taxonomy]
+                raise RuntimeError(  # qlint: ignore[taxonomy] chaos-injected
                     f"injected failure for {task_id}")
         """,
     })
     assert run_passes(idx, ["taxonomy"]) == []
+
+
+def test_taxonomy_covers_telemetry_and_cache():
+    """Round 14 scope extension: telemetry/ and the serving cache are
+    runtime paths too — an erased error type there silently disables a
+    surface instead of reaching dispatch."""
+    idx = index_of(**{
+        "pkg.telemetry.metrics": """
+            def scrape(fn):
+                try:
+                    return fn()
+                except Exception:
+                    return None
+        """,
+        "pkg.cache": """
+            def lookup(key):
+                raise RuntimeError("bad key")
+        """,
+        # fault.py-style exemption preserved
+        "pkg.telemetry.fault": "def g():\n    raise RuntimeError('y')\n",
+    })
+    found = run_passes(idx, ["taxonomy"])
+    assert ("taxonomy", "broad-swallow") in rules(found)
+    assert ("taxonomy", "bare-raise") in rules(found)
+    assert not any(f.module == "pkg.telemetry.fault" for f in found)
 
 
 # -- blocked-protocol ----------------------------------------------------
@@ -551,6 +569,794 @@ def test_blocked_protocol_repo_idioms_are_clean():
                     cb()
     """})
     assert run_passes(idx, ["blocked-protocol"]) == []
+
+
+# -- alias tracking (round 14 core) --------------------------------------
+
+def test_alias_local_rebind_resolves_lock_identity():
+    """`lk = self._lock; with lk:` used to scope the lock to the
+    function (invisible); alias expansion recovers the class identity,
+    so the self-routed re-acquire is a caught deadlock."""
+    idx = index_of(**{"pkg.locks": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                lk = self._lock
+                with lk:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert any(f.rule == "self-deadlock"
+               and "pkg.locks.A._lock" in f.subject for f in found)
+
+
+def test_alias_rebound_name_never_unifies():
+    """A name bound twice is NOT a must-alias: no finding may be
+    fabricated from it."""
+    idx = index_of(**{"pkg.locks": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._other = threading.RLock()
+
+            def outer(self):
+                lk = self._lock
+                lk = self._other
+                with lk:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """})
+    assert run_passes(idx, ["lock-order"]) == []
+
+
+def test_attr_types_resolve_cross_instance_calls():
+    """`self.ledger.park()` resolves through the __init__-typed
+    attribute — the old 3-part-chain dead end."""
+    idx = index_of(**{"pkg.m": """
+        import jax
+
+        class Ledger:
+            def park(self):
+                print("host effect")
+
+        class Ctx:
+            def __init__(self, ledger: Ledger):
+                self.ledger = ledger
+
+            @jax.jit
+            def kernel(self, x):
+                self.ledger.park()
+                return x
+    """})
+    found = run_passes(idx, ["trace-purity"])
+    assert any(f.qualname == "Ledger.park" for f in found)
+
+
+def test_attr_types_ambiguity_tombstones():
+    """An attribute assigned two different types must resolve to
+    NOTHING (no finding can be fabricated from a may-alias)."""
+    idx = index_of(**{"pkg.m": """
+        import jax
+
+        class Ledger:
+            def park(self):
+                print("host effect")
+
+        class Other:
+            def park(self):
+                return 1
+
+        class Ctx:
+            def __init__(self, ledger: Ledger, other: Other, flag):
+                if flag:
+                    self.dep = ledger
+                else:
+                    self.dep = other
+
+            @jax.jit
+            def kernel(self, x):
+                self.dep.park()
+                return x
+    """})
+    assert run_passes(idx, ["trace-purity"]) == []
+
+
+def test_attr_types_untyped_rebind_tombstones():
+    """An attribute rebound from an UNannotated name (or a lowercase
+    factory call) is ambiguous — the earlier typed assignment must not
+    survive, or a may-alias could fabricate findings."""
+    idx = index_of(**{"pkg.m": """
+        import jax
+
+        class Ledger:
+            def park(self):
+                print("host effect")
+
+        class Ctx:
+            def __init__(self):
+                self.dep = Ledger()
+
+            def adopt(self, thing):
+                self.dep = thing
+
+            @jax.jit
+            def kernel(self, x):
+                self.dep.park()
+                return x
+    """})
+    assert run_passes(idx, ["trace-purity"]) == []
+
+
+def test_returned_attribute_accessor_names_the_lock():
+    """`with ctx.lock():` where lock() returns self._lock acquires the
+    target class's attribute — visible in the acquisition graph."""
+    from trino_tpu.analysis.lock_order import build_lock_graph
+    idx = index_of(**{"pkg.m": """
+        import threading
+
+        class Ctx:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def lock(self):
+                return self._lock
+
+        class Spiller:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def spill(self, ctx: Ctx):
+                with self._lock:
+                    with ctx.lock():
+                        pass
+    """})
+    lg = build_lock_graph(idx)
+    assert "pkg.m.Ctx._lock" in lg.graph.get("pkg.m.Spiller._lock", set())
+    assert ("pkg.m.Spiller._lock", "pkg.m.Ctx._lock") \
+        in lg.cross_instance_edges
+
+
+# -- lock-order: cross-instance + parametric flow -------------------------
+
+CROSS_AB_BA = """
+    import threading
+
+    class Ledger:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def demote(self, ctx: "Ctx"):
+            with self._lock:
+                spill_pages([], lock=ctx._lock)
+
+        def park(self):
+            with self._lock:
+                pass
+
+    def spill_pages(pages, lock=None):
+        with lock:
+            return pages
+
+    class Ctx:
+        def __init__(self, ledger: Ledger):
+            self._lock = threading.Lock()
+            self.ledger = ledger
+
+        def finish(self):
+            with self._lock:
+                self.ledger.park()
+"""
+
+
+def test_lock_order_cross_instance_ab_ba_via_argument_flow():
+    """The seeded cycle the OLD pass provably missed on both edges:
+    the forward edge needs parametric lock flow (`lock=ctx._lock` into
+    `with lock:` — the old pass scoped the param lock to
+    spill_pages), the back edge needs typed-attribute resolution
+    (`self.ledger.park()` — the old pass dropped 3-part chains)."""
+    from trino_tpu.analysis.lock_order import build_lock_graph
+    idx = index_of(**{"pkg.spill": CROSS_AB_BA})
+    found = run_passes(idx, ["lock-order"])
+    cycles = [f for f in found if f.rule == "lock-cycle"]
+    assert len(cycles) == 1
+    assert "Ledger._lock" in cycles[0].message
+    assert "Ctx._lock" in cycles[0].message
+    assert "cross-instance" in cycles[0].message
+    lg = build_lock_graph(idx)
+    assert ("pkg.spill.Ledger._lock", "pkg.spill.Ctx._lock") \
+        in lg.cross_instance_edges
+
+
+def test_lock_order_param_flow_nonblocking_stays_clean():
+    """The same shape with a non-blocking try on the flowed lock (the
+    demote_across idiom) must not cycle."""
+    idx = index_of(**{"pkg.spill": CROSS_AB_BA.replace(
+        "with lock:\n            return pages",
+        "ok = lock.acquire(blocking=False)\n        return pages")})
+    found = run_passes(idx, ["lock-order"])
+    assert [f for f in found if f.rule == "lock-cycle"] == []
+
+
+def test_lock_order_parametric_must_alias_self_deadlock():
+    """Passing the HELD lock itself into a helper that blocking-
+    acquires its parameter is a must-alias self-deadlock — provable
+    only through argument flow."""
+    idx = index_of(**{"pkg.m": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    helper(self._lock)
+
+        def helper(lock):
+            lock.acquire()
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert any(f.rule == "self-deadlock"
+               and "flows through a call argument" in f.message
+               for f in found)
+
+
+def test_lock_order_direct_nested_two_instances_not_self_deadlock():
+    """Hand-over-hand locking of TWO instances of one class directly
+    nested in one body (`with self._lock: with other._lock:`) is
+    ordered locking, not a self-cycle: structural id equality alone
+    must not report — only identical source chains prove same-object."""
+    idx = index_of(**{"pkg.m": """
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def transfer(self, other: "Pool"):
+                with self._lock:
+                    with other._lock:
+                        pass
+
+            def reacquire(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """})
+    found = run_passes(idx, ["lock-order"])
+    subs = [f for f in found if f.rule == "self-deadlock"]
+    assert [f.qualname for f in subs] == ["Pool.reacquire"]
+
+
+def test_lock_order_via_self_on_peer_lock_not_self_deadlock():
+    """Holding a PEER instance's structurally-equal lock
+    (`self.other._lock`) while self-calling a method that takes this
+    instance's own lock is ordered locking — via_self alone must not
+    report; both sides must be the instance's OWN attribute."""
+    idx = index_of(**{"pkg.m": """
+        import threading
+
+        class Pool:
+            def __init__(self, other: "Pool" = None):
+                self._lock = threading.Lock()
+                self.other = other
+
+            def f(self):
+                with self.other._lock:
+                    self.park()
+
+            def park(self):
+                with self._lock:
+                    pass
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert [f for f in found if f.rule == "self-deadlock"] == []
+
+
+def test_lock_order_alias_to_unlockish_name_still_acquires():
+    """`lock = self._mu; with lock:` — the RAW name qualifies even
+    when the canonical target's name doesn't look lockish; dropping it
+    would lose lock-over-rpc/cycle detection the old pass had."""
+    idx = index_of(**{"pkg.srv": """
+        import threading, subprocess
+
+        class S:
+            def __init__(self):
+                self._mu = threading.Lock()
+
+            def ship(self):
+                lock = self._mu
+                with lock:
+                    subprocess.run(["scp", "x"])
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert ("lock-order", "lock-over-rpc") in rules(found)
+
+
+def test_lock_order_param_flow_of_peer_lock_not_self_deadlock():
+    """Handing a DIFFERENT instance's structurally-equal lock to a
+    blocking helper while holding your own is a cross-instance
+    hand-off: the must-alias claim requires the flowed argument's
+    source chain to BE the held chain."""
+    idx = index_of(**{"pkg.m": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def transfer(self, other: "A"):
+                with self._lock:
+                    grab(other._lock)
+
+        def grab(lock):
+            lock.acquire()
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert [f for f in found if f.rule == "self-deadlock"] == []
+
+
+def test_lock_order_rebound_head_defeats_same_object_claim():
+    """Two textually-identical chains whose head is REBOUND between
+    the acquisitions (`ctx = self._next`) are not the same object —
+    chain equality needs a non-rebindable head."""
+    idx = index_of(**{"pkg.m": """
+        import threading
+
+        class Ctx:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self._next = None
+
+        class W:
+            def drain(self, ctx: Ctx):
+                with ctx.lock:
+                    ctx = ctx._next
+                    with ctx.lock:
+                        pass
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert [f for f in found if f.rule == "self-deadlock"] == []
+
+
+def test_lock_order_with_item_call_joins_the_graph():
+    """A call made INSIDE a with-item expression (`with enter_chan():`)
+    must reach the call graph — its transitive acquisitions close
+    real AB-BA cycles."""
+    idx = index_of(**{"pkg.m": """
+        import threading
+
+        LOCK_A = threading.Lock()
+        LOCK_B = threading.Lock()
+
+        def enter_chan():
+            LOCK_B.acquire()
+            return open("/dev/null")
+
+        def forward():
+            with LOCK_A:
+                with enter_chan():
+                    pass
+
+        def backward():
+            with LOCK_B:
+                with LOCK_A:
+                    pass
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert any(f.rule == "lock-cycle" for f in found), \
+        [f.render() for f in found]
+
+
+def test_bind_args_respects_varargs_and_kwonly():
+    """`helper(1, self._lock)` into `def helper(x, *args, lock=None)`
+    puts the lock in *args at runtime — binding it to the kwonly
+    `lock` would fabricate a must-alias self-deadlock."""
+    idx = index_of(**{"pkg.m": """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    helper(1, self._lock)
+
+        def helper(x, *args, lock=None):
+            if lock is not None:
+                lock.acquire()
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert [f for f in found if f.rule == "self-deadlock"] == []
+
+
+def test_lock_order_two_instances_same_class_not_conflated():
+    """Structural identity must NOT turn two instances of one class
+    into a self-cycle: a tree of Pools locking parent-then-child is
+    fine."""
+    idx = index_of(**{"pkg.m": """
+        import threading
+
+        class Pool:
+            def __init__(self, parent: "Pool" = None):
+                self._lock = threading.Lock()
+                self.parent = parent
+
+            def charge(self, other: "Pool"):
+                with self._lock:
+                    other.snapshot()
+
+            def snapshot(self):
+                with self._lock:
+                    pass
+    """})
+    found = run_passes(idx, ["lock-order"])
+    assert [f for f in found if f.rule == "self-deadlock"] == []
+
+
+# -- cache-coherence -------------------------------------------------------
+
+def test_cache_coherence_lru_session_read_min_collectives_class():
+    """THE acceptance fixture: a session-property read inside an
+    lru_cache'd builder whose key omits it fails the pass (the PR 5
+    `min_collectives` bug class)."""
+    idx = index_of(**{"pkg.exch": """
+        from functools import lru_cache
+        from .. import session_properties as SP
+
+        @lru_cache(maxsize=8)
+        def build_program(mesh, n):
+            min_c = SP.prop_value({}, "rebalance_min_collectives")
+            return (mesh, n, min_c)
+    """})
+    found = run_passes(idx, ["cache-coherence"])
+    hits = [f for f in found if f.rule == "unkeyed-session-read"]
+    assert len(hits) == 1
+    assert "rebalance_min_collectives" in hits[0].message
+    assert "build_program" in hits[0].message
+
+
+def test_cache_coherence_memo_env_and_global_reads():
+    idx = index_of(**{"pkg.progcache": """
+        import os
+
+        _MODE = "auto"
+
+        def set_mode(m):
+            global _MODE
+            _MODE = m
+
+        class Builder:
+            def __init__(self):
+                self._programs = {}
+
+            def get(self, key):
+                hit = self._programs.get(key)
+                if hit is None:
+                    flavor = os.environ.get("FLAVOR", "")
+                    hit = self._programs[key] = (key, flavor, _MODE)
+                return hit
+    """})
+    found = run_passes(idx, ["cache-coherence"])
+    got = rules(found)
+    assert ("cache-coherence", "unkeyed-env-read") in got
+    assert ("cache-coherence", "unkeyed-global-read") in got
+    env = [f for f in found if f.rule == "unkeyed-env-read"]
+    assert "'FLAVOR'" in env[0].message
+
+
+def test_cache_coherence_interprocedural_reach():
+    """A helper the builder calls reads the property: flagged with the
+    builder named (the read is reachable from memoized code)."""
+    idx = index_of(**{"pkg.exch": """
+        from functools import lru_cache
+        from .. import session_properties as SP
+
+        def pick_sizing():
+            return SP.prop_value({}, "device_exchange_sizing")
+
+        @lru_cache(maxsize=8)
+        def build_program(mesh):
+            return (mesh, pick_sizing())
+    """})
+    found = run_passes(idx, ["cache-coherence"])
+    hits = [f for f in found if f.rule == "unkeyed-session-read"]
+    assert len(hits) == 1
+    assert "reached from cached builder build_program" in hits[0].message
+    assert hits[0].qualname == "pick_sizing"
+
+
+def test_cache_coherence_keyed_reads_are_clean():
+    """Hoisting the read into the key (the canonical fix) and
+    constant globals produce no findings; a caller reading props
+    OUTSIDE the builder is the designed shape."""
+    idx = index_of(**{"pkg.ok": """
+        from functools import lru_cache
+        from .. import session_properties as SP
+
+        _CONST = 8
+
+        @lru_cache(maxsize=8)
+        def build_program(mesh, min_c):
+            return (mesh, min_c, _CONST)
+
+        def run(mesh, session):
+            min_c = SP.value(session, "rebalance_min_collectives")
+            return build_program(mesh, min_c)
+
+        class Builder:
+            def __init__(self):
+                self._programs = {}
+
+            def get(self, key, flavor):
+                hit = self._programs.get((key, flavor))
+                if hit is None:
+                    hit = self._programs[(key, flavor)] = (key, flavor)
+                return hit
+    """})
+    assert run_passes(idx, ["cache-coherence"]) == []
+
+
+def test_cache_coherence_memo_read_in_key_is_coherent():
+    """A memo builder whose env/session read flows INTO the memo key
+    is coherent by construction — the read cannot leave get-or-build
+    there, so the pass must recognize it in place (the lru fix of
+    'hoist into the key' has no memo equivalent)."""
+    idx = index_of(**{"pkg.ok": """
+        import os
+
+        class Builder:
+            def __init__(self):
+                self._programs = {}
+
+            def get(self, key):
+                flavor = os.environ.get("FLAVOR", "")
+                k = (key, flavor)
+                hit = self._programs.get(k)
+                if hit is None:
+                    hit = self._programs[k] = (key, flavor)
+                return hit
+    """})
+    assert run_passes(idx, ["cache-coherence"]) == []
+
+
+def test_cache_coherence_inline_key_read_and_aliased_container():
+    """A read INLINE in the key expression, and a container reached
+    through a local alias, are both keyed — coherent."""
+    idx = index_of(**{"pkg.ok": """
+        import os
+
+        class B:
+            def __init__(self):
+                self._programs = {}
+
+            def inline(self, key):
+                hit = self._programs.get(
+                    (key, os.environ.get("FLAVOR", "")))
+                if hit is None:
+                    self._programs[(key, "x")] = key
+                return hit
+
+        class C:
+            def __init__(self):
+                self._programs = {}
+
+            def aliased(self, key):
+                d = self._programs
+                flavor = os.environ.get("FLAVOR", "")
+                k = (key, flavor)
+                hit = d.get(k)
+                if hit is None:
+                    hit = d[k] = (key, flavor)
+                return hit
+    """})
+    assert run_passes(idx, ["cache-coherence"]) == []
+
+
+def test_cache_coherence_global_container_not_its_own_input():
+    """A lazily-initialized/resettable `global _CACHE` container is
+    the cache itself, not an input missing from its own key."""
+    idx = index_of(**{"pkg.m": """
+        _CACHE = None
+
+        def reset():
+            global _CACHE
+            _CACHE = None
+
+        def get_prog(key):
+            global _CACHE
+            if _CACHE is None:
+                _CACHE = {}
+            v = _CACHE.get(key)
+            if v is None:
+                v = _CACHE[key] = (key,)
+            return v
+    """})
+    assert run_passes(idx, ["cache-coherence"]) == []
+
+
+def test_cache_coherence_rmw_accumulators_are_not_builders():
+    """Refcounts/EWMAs (`d[k] = d.get(k, 0) + 1`) cache nothing: a
+    session read beside one must not be flagged — tightening here is
+    what keeps product code from contorting around the pass."""
+    from trino_tpu.analysis.cache_coherence import cached_builders
+    idx = index_of(**{"pkg.w": """
+        from .. import session_properties as SP
+
+        class W:
+            def __init__(self):
+                self._refs = {}
+
+            def acquire(self, qid, session):
+                self._refs[qid] = self._refs.get(qid, 0) + 1
+                return SP.prop_value(session, "query_max_memory_bytes")
+    """})
+    assert run_passes(idx, ["cache-coherence"]) == []
+    assert cached_builders(idx) == {}
+
+
+def test_cache_coherence_pragma_opt_out():
+    idx = index_of(**{"pkg.exch": """
+        import os
+        from functools import lru_cache
+
+        @lru_cache(maxsize=8)
+        def build(mesh):
+            mode = os.environ.get("MODE", "")  # qlint: ignore[cache-coherence] trace-static
+            return (mesh, mode)
+    """})
+    assert run_passes(idx, ["cache-coherence"]) == []
+
+
+# -- resource-lifecycle ----------------------------------------------------
+
+SPOOLY = """
+    class SpoolCursor:
+        def __init__(self, path):
+            self.path = path
+
+        def poll(self):
+            return None
+
+        def close(self):
+            pass
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            self.close()
+"""
+
+
+def test_resource_lifecycle_leak_and_conditional_close():
+    idx = index_of(**{"pkg.spool": SPOOLY + """
+    def leak(path):
+        cur = SpoolCursor(path)
+        return cur.poll()
+
+    def racy(path):
+        cur = SpoolCursor(path)
+        page = cur.poll()
+        cur.close()
+        return page
+
+    def dropped(path):
+        SpoolCursor(path)
+    """})
+    found = run_passes(idx, ["resource-lifecycle"])
+    by_rule = {}
+    for f in found:
+        by_rule.setdefault(f.rule, []).append(f)
+    leaks = by_rule.get("leaked-closeable", [])
+    assert any(f.qualname == "leak" for f in leaks)
+    assert any(f.qualname == "dropped" for f in leaks)
+    conds = by_rule.get("close-not-guaranteed", [])
+    assert [f.qualname for f in conds] == ["racy"]
+
+
+def test_resource_lifecycle_satisfied_shapes_are_clean():
+    """with / finally / teardown-list registration / weakref.finalize /
+    escape (return, self-store, container) all discharge the
+    obligation — the engine's own idioms."""
+    idx = index_of(**{"pkg.spool": SPOOLY + """
+    import weakref
+
+    def with_managed(path):
+        with SpoolCursor(path) as cur:
+            return cur.poll()
+
+    def finally_closed(path):
+        cur = SpoolCursor(path)
+        try:
+            return cur.poll()
+        finally:
+            cur.close()
+
+    def registered(path, state):
+        cur = SpoolCursor(path)
+        state.channels.append(cur)
+
+    def finalized(path):
+        cur = SpoolCursor(path)
+        weakref.finalize(cur, print, path)
+        return cur
+
+    def factory(path):
+        return SpoolCursor(path)
+
+    class Owner:
+        def __init__(self, path):
+            self._cur = SpoolCursor(path)
+
+        def close(self):
+            self._cur.close()
+    """})
+    assert run_passes(idx, ["resource-lifecycle"]) == []
+
+
+def test_resource_lifecycle_factory_propagates():
+    """A caller of a closeable FACTORY holds a closeable exactly as if
+    it had called the constructor."""
+    idx = index_of(**{"pkg.spool": SPOOLY + """
+    def spool_channel(path):
+        return SpoolCursor(path)
+
+    def consumer(path):
+        chan = spool_channel(path)
+        chan.poll()
+    """})
+    found = run_passes(idx, ["resource-lifecycle"])
+    assert any(f.rule == "leaked-closeable" and f.qualname == "consumer"
+               for f in found)
+
+
+def test_resource_lifecycle_open_builtin_and_pragma():
+    idx = index_of(**{"pkg.io": """
+    def bad(path):
+        f = open(path)
+        return f.read()
+
+    def opted(path):
+        f = open(path)  # qlint: ignore[resource-lifecycle] fd handed to C extension
+        return f.read()
+
+    def good(path):
+        with open(path) as f:
+            return f.read()
+    """})
+    found = run_passes(idx, ["resource-lifecycle"])
+    assert [f.qualname for f in found] == ["bad"]
+
+
+# -- pragma audit ----------------------------------------------------------
+
+def test_pragma_audit_flags_bare_and_accepts_reasoned():
+    idx = index_of(**{"pkg.m": """
+        def f():
+            x = 1  # qlint: ignore[taxonomy]
+            y = 2  # qlint: ignore[trace-purity] deliberate trace-time read
+            return x + y
+    """})
+    found = run_passes(idx, ["taxonomy"])
+    bare = [f for f in found if f.pass_id == "pragma"]
+    assert len(bare) == 1
+    assert bare[0].rule == "missing-reason"
+    assert "taxonomy" in bare[0].message
 
 
 # -- framework plumbing --------------------------------------------------
@@ -650,6 +1456,40 @@ def test_gate_passes_are_not_blind_on_the_real_repo(repo_findings):
     assert "trino_tpu.parallel.remote_exchange:RemoteExchangeChannel" \
         in chans
     assert "trino_tpu.parallel.spool:SpoolCursor" in chans
+    # round 14: the cache-coherence pass must see the engine's caches
+    # (lru program builders AND hand-rolled memo dicts) ...
+    from trino_tpu.analysis.cache_coherence import cached_builders
+    builders = cached_builders(index)
+    assert len(builders) >= 10, sorted(builders)
+    assert "trino_tpu.parallel.device_exchange:_exchange_program" \
+        in builders
+    assert builders[
+        "trino_tpu.parallel.device_exchange:_exchange_program"].kind \
+        == "lru"
+    assert "trino_tpu.cache:ProcessorCache.get" in builders
+    assert "trino_tpu.cache:QueryCache.parse" in builders
+    assert "trino_tpu.parallel.mesh_query:_cached_program" in builders
+    # ... the resource-lifecycle pass must see the closeables ...
+    from trino_tpu.analysis.resource_lifecycle import (
+        closeable_classes, closeable_factories)
+    closeables = closeable_classes(index)
+    assert len(closeables) >= 5, sorted(closeables)
+    for cls in ("SpoolCursor", "_ChainedSpoolCursor",
+                "RemoteExchangeChannel", "DiskSpiller",
+                "QueryMemoryPool"):
+        assert cls in closeables, cls
+    factories = closeable_factories(index, closeables)
+    assert "trino_tpu.parallel.spool:spool_channel" in factories
+    assert "trino_tpu.parallel.spool:spool_task_cursor" in factories
+    # ... and alias tracking must resolve CROSS-INSTANCE acquisition
+    # edges on the real lock graph (the carried ROADMAP follow-on:
+    # the old pass excluded these structurally)
+    from trino_tpu.analysis.lock_order import build_lock_graph
+    lg = build_lock_graph(index)
+    assert lg.cross_instance_edges, "no cross-instance lock edges"
+    assert ("trino_tpu.parallel.worker.WorkerServer._lock",
+            "trino_tpu.exec.memory.NodeMemoryPool._lock") \
+        in lg.cross_instance_edges, sorted(lg.cross_instance_edges)
     # the compiled-program profiler (round 11) must cover the jit
     # entry points: instrument() registrations are indexed by name so
     # a dropped wrapper can't silently blind EXPLAIN ANALYZE VERBOSE,
@@ -692,18 +1532,48 @@ def test_hbo_record_path_indexed_and_outside_jit(repo_findings):
         + ", ".join(sorted(inside)))
 
 
+def test_eight_passes_registered():
+    assert sorted(PASSES) == sorted([
+        "trace-purity", "lock-order", "recompile", "session-props",
+        "taxonomy", "blocked-protocol", "cache-coherence",
+        "resource-lifecycle"])
+
+
+def test_analyzer_wall_clock_ratchet():
+    """The suite is a pre-commit gate: a FULL fresh run (index + all
+    eight passes + pragma audit) must stay under 10 s on CPU. A pass
+    that regresses this turns the tier-1 gate and the bench pre-flight
+    into the slow path everyone skips. Measured as PROCESS CPU time —
+    the analyzer is single-threaded pure Python, so this equals wall
+    on an idle host but cannot flake under CI contention (the same
+    reason the QPS ratchet gates on a self-normalizing ratio)."""
+    import time
+    t0 = time.process_time()
+    index = ProjectIndex.from_package(PACKAGE)
+    run_passes(index)
+    elapsed = time.process_time() - t0
+    assert elapsed < 10.0, f"qlint full run took {elapsed:.2f}s CPU"
+
+
 def test_cli_runs_clean_and_json(tmp_path):
     """`python -m trino_tpu.analysis` end to end: rc 0 on the clean
-    tree, JSON shape, and rc 1 + stale reporting on a bad baseline."""
+    tree, SARIF 2.1.0 shape, and rc 1 + stale reporting on a bad
+    baseline."""
     env = dict(os.environ, PYTHONPATH=REPO)
     out = subprocess.run(
         [sys.executable, "-m", "trino_tpu.analysis", "--json", PACKAGE],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
     payload = json.loads(out.stdout)
-    assert payload["new"] == []
-    assert payload["stale_baseline_keys"] == []
-    assert sorted(payload["passes"]) == sorted(PASSES)
+    assert payload["version"] == "2.1.0"
+    assert "sarif" in payload["$schema"]
+    run = payload["runs"][0]
+    assert run["tool"]["driver"]["name"] == "qlint"
+    assert run["results"] == []
+    props = run["properties"]
+    assert props["new"] == []
+    assert props["stale_baseline_keys"] == []
+    assert sorted(props["passes"]) == sorted(PASSES)
 
     bad = tmp_path / "baseline.json"
     bad.write_text(json.dumps(
@@ -724,10 +1594,65 @@ def test_cli_pass_selection(tmp_path):
          "--passes", "session-props,taxonomy", "--json", PACKAGE],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
-    assert json.loads(out.stdout)["passes"] == ["session-props",
-                                               "taxonomy"]
+    payload = json.loads(out.stdout)
+    assert payload["runs"][0]["properties"]["passes"] == \
+        ["session-props", "taxonomy"]
     out = subprocess.run(
         [sys.executable, "-m", "trino_tpu.analysis",
          "--passes", "bogus", PACKAGE],
         capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
     assert out.returncode == 2
+
+
+def test_cli_changed_since(tmp_path):
+    """Diff-aware pre-commit mode: full-index analysis, report
+    filtered to files the git diff touched; SARIF results carry the
+    same filter."""
+    pkg = tmp_path / "pkg"
+    (pkg / "parallel").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "parallel" / "__init__.py").write_text("")
+    (pkg / "parallel" / "a.py").write_text(
+        "def fa():\n    raise RuntimeError('a')\n")
+    (pkg / "parallel" / "b.py").write_text(
+        "def fb():\n    raise RuntimeError('b')\n")
+
+    def git(*args):
+        out = subprocess.run(
+            ["git", "-C", str(tmp_path), "-c", "user.name=t",
+             "-c", "user.email=t@t", *args],
+            capture_output=True, text=True, timeout=30)
+        assert out.returncode == 0, out.stderr
+        return out
+
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # touch ONLY a.py: its finding reports, b.py's is filtered out
+    (pkg / "parallel" / "a.py").write_text(
+        "def fa():\n    x = 1\n    raise RuntimeError('a')\n")
+
+    # an UNTRACKED new module must be part of the changed set too: a
+    # pre-commit gate that can't see files before `git add` is useless
+    (pkg / "parallel" / "c.py").write_text(
+        "def fc():\n    raise RuntimeError('c')\n")
+
+    env = dict(os.environ, PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, "-m", "trino_tpu.analysis", str(pkg),
+         "--no-baseline", "--changed-since", "HEAD"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "pkg.parallel.a" in out.stdout
+    assert "pkg.parallel.c" in out.stdout
+    assert "pkg.parallel.b" not in out.stdout
+    assert "changed-since HEAD" in out.stderr
+
+    # the full run still sees both
+    out = subprocess.run(
+        [sys.executable, "-m", "trino_tpu.analysis", str(pkg),
+         "--no-baseline"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120)
+    assert out.returncode == 1
+    assert "pkg.parallel.a" in out.stdout
+    assert "pkg.parallel.b" in out.stdout

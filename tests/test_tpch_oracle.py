@@ -28,6 +28,21 @@ def _days_to_iso(d):
     return (EPOCH + datetime.timedelta(days=d)).isoformat()
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_jax_caches():
+    """Late in a full tier-1 run this module's q17 compile aborts
+    inside XLA (SIGABRT in backend_compile, CPU, single process,
+    ~600 compiled programs accumulated; reproduces identically on the
+    pre-PR-14 tree and with a cold persistent cache, passes when the
+    module runs alone). Dropping the in-process jit caches before the
+    module bounds the accumulated-executable state the crash needs;
+    the queries recompile from the persistent on-disk cache, so the
+    cost is seconds, not a cold trace."""
+    import jax
+
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="module")
 def conn():
     return TpchConnector(page_rows=8192)

@@ -5,7 +5,7 @@ The engine carries several correctness invariants that exist only as
 prose in docstrings and PR descriptions; each was a hand-found bug
 once.  This package machine-checks them with stdlib ``ast`` (no JAX
 import, no new deps) over a shared module-index/call-graph core
-(``core.py``) and six passes:
+(``core.py``, alias-aware since round 14) and eight passes:
 
 - ``trace-purity`` — no host side-effects (spans, metrics, locks,
   ``time.*``, IO, ``print``) reachable inside jit'd/shard_map'd/Pallas
@@ -20,18 +20,34 @@ import, no new deps) over a shared module-index/call-graph core
 - ``session-props`` — every property looked up against the registry is
   declared, every declared property has a read site, declared types
   come from the registry vocabulary;
-- ``taxonomy`` — in ``parallel/``, no bare ``raise RuntimeError`` /
-  ``raise Exception`` and no broad ``except Exception`` handlers that
-  swallow without routing through ``parallel/fault.py``;
+- ``taxonomy`` — in ``parallel/``, ``telemetry/`` and ``cache.py``
+  (fault.py exempt), no bare ``raise RuntimeError`` / ``raise
+  Exception`` and no broad ``except Exception`` handlers that swallow
+  without routing through ``parallel/fault.py``;
 - ``blocked-protocol`` — the streaming driver's Blocked/listen-token
   contract: channels implement the full poll/at_end/has_page/listen
   quartet, ``blocked_token`` re-checks readiness after its ``listen()``
-  snapshot, waker callbacks never fire under a held lock.
+  snapshot, waker callbacks never fire under a held lock;
+- ``cache-coherence`` — every mutable input a cached builder reads
+  (session properties, env vars, rebindable module globals) is part of
+  its cache key (the PR 5 ``min_collectives`` bug class, generalized
+  to memo-dict builders and interprocedural reach);
+- ``resource-lifecycle`` — every constructed closeable (spool cursors,
+  exchange channels, spillers, ``open()`` files) reaches ``close()``
+  on all paths: ``with``, ``finally``, teardown-list registration or
+  ``weakref.finalize`` all count (the PR 8 leaked-cursor class).
+
+The shared core is alias-aware (round 14): single-assignment local
+rebinds, ``__init__``-typed ``self.*`` attributes, returned-attribute
+accessors and call-argument flow all canonicalize to one identity, so
+lock-order resolves CROSS-INSTANCE acquisition edges structurally.
 
 Checked-in suppressions live in ``analysis_baseline.json`` at the repo
 root (pre-existing, triaged findings only — the file may only shrink);
 line-level opt-outs use ``# qlint: ignore[<pass>] <reason>`` for
-effects that are deliberate (e.g. trace-time-only counters).
+effects that are deliberate (e.g. trace-time-only counters). The
+trailing reason is MANDATORY: a bare pragma is itself reported by the
+always-on framework audit (``pragma/missing-reason``).
 
 CLI: ``python -m trino_tpu.analysis [--json] [--passes a,b] [path]``.
 Tier-1 gate: ``tests/test_static_analysis.py`` runs every pass over
@@ -80,6 +96,16 @@ def _pass_blocked_protocol(index):
     return run(index)
 
 
+def _pass_cache_coherence(index):
+    from .cache_coherence import run
+    return run(index)
+
+
+def _pass_resource_lifecycle(index):
+    from .resource_lifecycle import run
+    return run(index)
+
+
 #: pass slug -> runner(index) -> List[Finding]; slugs are the names
 #: used by --passes, pragmas and baseline keys
 PASSES = {
@@ -89,13 +115,42 @@ PASSES = {
     "session-props": _pass_session_props,
     "taxonomy": _pass_taxonomy,
     "blocked-protocol": _pass_blocked_protocol,
+    "cache-coherence": _pass_cache_coherence,
+    "resource-lifecycle": _pass_resource_lifecycle,
 }
+
+
+def _audit_pragmas(index: ProjectIndex) -> List[Finding]:
+    """Framework-level audit (always on, every run): a ``# qlint:
+    ignore[...]`` pragma with no trailing reason is itself a finding —
+    a suppression nobody can review is a suppression that outlives its
+    justification."""
+    findings: List[Finding] = []
+    for mod_name in sorted(index.modules):
+        mod = index.modules[mod_name]
+        ordinals: dict = {}
+        for line in sorted(mod.pragmas):
+            if mod.pragma_reasons.get(line, ""):
+                continue
+            passes = ",".join(sorted(mod.pragmas[line]))
+            info = mod.enclosing_function(line)
+            qual = info.qualname if info is not None else ""
+            n = ordinals.get((qual, passes), 0)
+            ordinals[(qual, passes)] = n + 1
+            findings.append(Finding(
+                "pragma", "missing-reason", mod_name, qual, line,
+                f"bare `# qlint: ignore[{passes}]` without a trailing "
+                f"reason — state WHY the effect is deliberate so the "
+                f"suppression stays reviewable",
+                f"bare:{passes}:{n}"))
+    return findings
 
 
 def run_passes(index: ProjectIndex,
                passes: Optional[Iterable[str]] = None) -> List[Finding]:
-    """Run the selected passes (all by default) and return pragma-
-    filtered findings, stable-sorted for deterministic output."""
+    """Run the selected passes (all by default) plus the always-on
+    pragma audit, and return pragma-filtered findings, stable-sorted
+    for deterministic output."""
     selected = list(passes) if passes is not None else list(PASSES)
     unknown = [p for p in selected if p not in PASSES]
     if unknown:
@@ -106,6 +161,7 @@ def run_passes(index: ProjectIndex,
         for f in PASSES[name](index):
             if not index.suppressed(f.module, f.line, f.pass_id):
                 findings.append(f)
+    findings.extend(_audit_pragmas(index))
     findings.sort(key=lambda f: (f.module, f.line, f.pass_id, f.rule,
                                  f.subject))
     return findings

@@ -7,6 +7,18 @@ findings OR stale baseline entries (the baseline may only shrink),
 pays the PARENT package's ``import jax`` — a context where that
 could hang (the bench parent) must load this package by file path
 instead (see bench.py ``_load_qlint``).
+
+``--changed-since <rev>`` is the pre-commit gate shape: the FULL
+index is still built (call graphs are whole-program — a pass run on a
+file subset would silently lose interprocedural findings), but only
+findings located in files the git diff touched are reported. Stale-
+baseline enforcement is skipped in that mode (a partial view cannot
+prove an entry dead).
+
+``--json`` emits a SARIF 2.1.0 document (one run, one result per
+non-baselined finding, baselined findings carried with an external
+suppression) so editors/CI ingest it directly; qlint's native payload
+rides in ``runs[0].properties``.
 """
 
 from __future__ import annotations
@@ -14,10 +26,117 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from . import (PASSES, ProjectIndex, apply_baseline, default_baseline_path,
                load_baseline, run_passes)
+
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemata/sarif-schema-2.1.0.json")
+
+
+def _git_toplevel(start_dir: str):
+    """The git working-tree root governing ``start_dir`` — diff paths
+    are relative to THIS, not to the analyzed package's parent (a
+    package nested below the git root would otherwise never
+    intersect the diff and the gate would silently pass)."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", start_dir, "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if out.returncode != 0:
+        return None
+    top = out.stdout.strip()
+    return top or None
+
+
+def _changed_files(git_root: str, rev: str):
+    """git-root-relative paths changed since ``rev`` (committed +
+    working tree + UNTRACKED — a brand-new module's findings must not
+    silently skip the pre-commit gate before `git add`), or None on
+    git failure (caller reports rc 2)."""
+    try:
+        diff = subprocess.run(
+            ["git", "-C", git_root, "diff", "--name-only", rev, "--"],
+            capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "-C", git_root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    return {line.strip()
+            for out in (diff.stdout, untracked.stdout)
+            for line in out.splitlines() if line.strip()}
+
+
+def _module_paths(index: ProjectIndex, repo_root: str):
+    """module name -> repo-relative source path. Both sides resolve
+    symlinks: `git rev-parse --show-toplevel` reports the PHYSICAL
+    path, so a checkout reached through a symlink (macOS /tmp, linked
+    worktrees) would otherwise never intersect the diff and the gate
+    would silently pass."""
+    root = os.path.realpath(repo_root)
+    out = {}
+    for name, mod in index.modules.items():
+        if mod.path and mod.path != "<memory>":
+            rel = os.path.relpath(os.path.realpath(mod.path), root)
+            # git (and SARIF artifact URIs) always use forward
+            # slashes; a Windows os.sep would never intersect the
+            # diff and silently pass the gate
+            out[name] = rel.replace(os.sep, "/")
+    return out
+
+
+def to_sarif(package_path: str, passes, new, suppressed, stale,
+             module_paths) -> dict:
+    """SARIF 2.1.0 shape: new findings as plain results, baselined
+    ones as results with an external suppression; the legacy qlint
+    payload rides in run properties."""
+    rule_ids = sorted({f"{f.pass_id}/{f.rule}"
+                       for f in list(new) + list(suppressed)})
+
+    def result(f, suppressed_entry: bool) -> dict:
+        uri = module_paths.get(f.module,
+                               f.module.replace(".", "/") + ".py")
+        out = {
+            "ruleId": f"{f.pass_id}/{f.rule}",
+            "level": "error",
+            "message": {"text": f.render()},
+            "locations": [{"physicalLocation": {
+                "artifactLocation": {"uri": uri},
+                "region": {"startLine": f.line}}}],
+            "partialFingerprints": {"qlintKey": f.key},
+        }
+        if suppressed_entry:
+            out["suppressions"] = [{"kind": "external",
+                                    "justification": "baselined"}]
+        return out
+
+    return {
+        "$schema": _SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "qlint",
+                "rules": [{"id": r} for r in rule_ids],
+            }},
+            "results": [result(f, False) for f in new]
+            + [result(f, True) for f in suppressed],
+            "properties": {
+                "package": package_path,
+                "passes": list(passes),
+                "new": [f.to_dict() for f in new],
+                "suppressed": [f.to_dict() for f in suppressed],
+                "stale_baseline_keys": list(stale),
+            },
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -25,7 +144,8 @@ def main(argv=None) -> int:
         prog="python -m trino_tpu.analysis",
         description="qlint: repo-native static analysis "
                     "(trace-purity, lock-order, recompile, "
-                    "session-props, taxonomy)")
+                    "session-props, taxonomy, blocked-protocol, "
+                    "cache-coherence, resource-lifecycle)")
     parser.add_argument("path", nargs="?", default=None,
                         help="package directory to analyze "
                              "(default: the trino_tpu package)")
@@ -33,7 +153,13 @@ def main(argv=None) -> int:
                         help="comma-separated pass subset "
                              f"(default: all of {','.join(PASSES)})")
     parser.add_argument("--json", action="store_true",
-                        help="machine-readable JSON on stdout")
+                        help="SARIF 2.1.0 on stdout (qlint payload in "
+                             "runs[0].properties)")
+    parser.add_argument("--changed-since", default=None, metavar="REV",
+                        help="report only findings in files the git "
+                             "diff since REV touched (full-index "
+                             "analysis, diff-filtered report — the "
+                             "fast pre-commit gate)")
     parser.add_argument("--baseline", default=None,
                         help="suppression file "
                              "(default: analysis_baseline.json next "
@@ -68,13 +194,43 @@ def main(argv=None) -> int:
             print("--write-baseline requires a full run "
                   "(drop --passes)", file=sys.stderr)
             return 2
+    if args.write_baseline and args.changed_since:
+        print("--write-baseline requires a full report "
+              "(drop --changed-since)", file=sys.stderr)
+        return 2
 
     index = ProjectIndex.from_package(package_path)
     findings = run_passes(index, passes)
 
+    repo_root = os.path.dirname(os.path.abspath(package_path))
+    changed_note = ""
+    if args.changed_since:
+        # diff paths are relative to the GIT top-level, which is not
+        # necessarily the package's parent directory
+        git_root = _git_toplevel(repo_root) or repo_root
+        changed = _changed_files(git_root, args.changed_since)
+        if changed is None:
+            print(f"git diff --name-only {args.changed_since} failed "
+                  f"under {git_root}", file=sys.stderr)
+            return 2
+        repo_root = git_root
+        module_paths = _module_paths(index, git_root)
+        before = len(findings)
+        findings = [f for f in findings
+                    if module_paths.get(f.module) in changed]
+        changed_note = (f" [changed-since {args.changed_since}: "
+                        f"{len(changed)} file(s), "
+                        f"{before - len(findings)} finding(s) outside "
+                        f"the diff]")
+    else:
+        module_paths = _module_paths(index, repo_root)
+
     baseline_path = args.baseline or default_baseline_path(package_path)
     baseline = {} if args.no_baseline else load_baseline(baseline_path)
     new, suppressed, stale = apply_baseline(findings, baseline)
+    if args.changed_since:
+        # a diff-filtered run cannot prove a baseline entry dead
+        stale = []
 
     if args.write_baseline:
         # preserve existing triage notes even under --no-baseline
@@ -94,13 +250,9 @@ def main(argv=None) -> int:
               file=sys.stderr)
 
     if args.json:
-        print(json.dumps({
-            "package": package_path,
-            "passes": passes or list(PASSES),
-            "new": [f.to_dict() for f in new],
-            "suppressed": [f.to_dict() for f in suppressed],
-            "stale_baseline_keys": stale,
-        }, indent=1))
+        print(json.dumps(to_sarif(
+            package_path, passes or list(PASSES), new, suppressed,
+            stale, module_paths), indent=1))
     else:
         for f in new:
             print(f.render())
@@ -110,7 +262,7 @@ def main(argv=None) -> int:
         print(f"qlint: {len(new)} finding(s), "
               f"{len(suppressed)} baselined, {len(stale)} stale "
               f"baseline entr{'y' if len(stale) == 1 else 'ies'} "
-              f"over {len(index.modules)} modules",
+              f"over {len(index.modules)} modules{changed_note}",
               file=sys.stderr)
     return 1 if (new or stale) else 0
 

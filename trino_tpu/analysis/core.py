@@ -1,5 +1,5 @@
 """Shared static-analysis core: module index, function table, call
-graph, pragma handling.
+graph, alias tracking, pragma handling.
 
 Everything is stdlib ``ast`` over source text — the analyzed package is
 never imported, so the analyzer runs without JAX (and cannot be fooled
@@ -12,6 +12,28 @@ purity/lock passes with false paths, under-approximating loses real
 ones — unambiguous-only is the tested middle ground, and the fixture
 tests in ``tests/test_static_analysis.py`` pin what each pass must
 still catch through it.
+
+Alias tracking (round 14) extends resolution with MUST-alias facts
+only — every fact is a definite "these two names denote the same
+object", never a may-alias guess, so the lock/lifecycle passes can
+unify identities without fabricating false cycles:
+
+- ``FunctionInfo.aliases``: single-assignment locals bound from a
+  dotted chain (``lock = self._lock``) expand in place during
+  resolution (``canonical_chain``);
+- ``ModuleInfo.attr_types``: ``self.x = <annotated param>`` /
+  ``self.x = ClassName(...)`` / ``self.x: ClassName`` in a method body
+  types the attribute, so chains like ``self.ledger.park()`` or
+  ``pool.host_ledger.charge()`` resolve through ``instance_type`` —
+  the seam that lets lock-order unify CROSS-INSTANCE lock identities
+  structurally (ambiguous re-assignments tombstone the attr);
+- ``FunctionInfo.returns_chain``: a method whose every return is the
+  same ``self.<attr>`` chain is a returned-attribute accessor —
+  ``obj.lock()`` in a with-item denotes the target class's attribute;
+- ``bind_args``: maps a resolved call's actual arguments onto the
+  callee's parameters, so a lock/resource flowing through
+  ``spill_pages(..., lock=ctx.lock)`` keeps its caller-side identity
+  inside the callee (parametric substitution in lock-order).
 """
 
 from __future__ import annotations
@@ -75,6 +97,16 @@ class FunctionInfo:
     annotations: Dict[str, str] = field(default_factory=dict)
     decorators: List[ast.expr] = field(default_factory=list)
     calls: List[CallSite] = field(default_factory=list)
+    #: single-assignment local name -> the dotted chain it MUST alias
+    #: (``lock = self._lock``); names bound more than once, bound by
+    #: loops/with/aug-assign, or shadowing a parameter never enter
+    aliases: Dict[str, str] = field(default_factory=dict)
+    #: per-name binding counts in this body (shared with lock-order's
+    #: chain-stability check — computed once, alongside ``aliases``)
+    bindings: Dict[str, int] = field(default_factory=dict)
+    #: when every ``return`` in the body returns the SAME dotted chain
+    #: (``return self._lock``) — the returned-attribute-accessor seam
+    returns_chain: Optional[str] = None
 
     @property
     def id(self) -> str:
@@ -89,7 +121,22 @@ class FunctionInfo:
         return self.node.body
 
 
-_PRAGMA_RE = re.compile(r"#\s*qlint:\s*ignore\[([a-z*,\s-]+)\]")
+_PRAGMA_RE = re.compile(r"#\s*qlint:\s*ignore\[([a-z*,\s-]+)\]\s*(.*)$")
+
+
+def own_nodes(func_node) -> Iterator[ast.AST]:
+    """Walk a function body EXCLUDING nested function bodies (they get
+    their own FunctionInfo); lambdas stay attributed to this frame —
+    the same ownership rule ``_collect_calls`` uses."""
+    stack: List[ast.AST] = [func_node]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                continue
+            stack.append(child)
 
 
 class ModuleInfo:
@@ -124,11 +171,20 @@ class ModuleInfo:
         self.classes: Dict[str, Dict[str, str]] = {}
         #: line -> set of pass slugs suppressed there
         self.pragmas: Dict[int, Set[str]] = {}
+        #: line -> the trailing reason text after the pragma ("" = bare
+        #: pragma, which the framework audit reports as a finding)
+        self.pragma_reasons: Dict[int, str] = {}
         for i, text in enumerate(source.splitlines(), start=1):
             m = _PRAGMA_RE.search(text)
             if m:
                 self.pragmas[i] = {p.strip()
                                    for p in m.group(1).split(",")}
+                self.pragma_reasons[i] = m.group(2).strip()
+        #: class name -> {attr name -> class name} typed from method
+        #: bodies (``self.x = <annotated param>`` / ``= ClassName()`` /
+        #: ``self.x: ClassName``); conflicting assignments tombstone
+        #: the attr with "" so ambiguity never resolves
+        self.attr_types: Dict[str, Dict[str, str]] = {}
         self._collect()
 
     # -- collection ------------------------------------------------------
@@ -149,6 +205,60 @@ class ModuleInfo:
                     self.from_imports[alias.asname or alias.name] = \
                         (target, alias.name)
         self._walk_scope(self.tree.body, scope="", class_name=None)
+        self._collect_attr_types()
+
+    def _collect_attr_types(self):
+        """Type ``self.<attr>`` from method bodies: an annotated-param
+        store, a direct ``ClassName(...)`` construction, or an
+        annotated ``self.x: T`` assignment each give a definite class;
+        two different candidates for one attr tombstone it ("") —
+        must-alias or nothing."""
+        def note(cls: str, attr: str, type_name: Optional[str]):
+            if type_name is None:
+                return
+            attrs = self.attr_types.setdefault(cls, {})
+            if attrs.get(attr, type_name) != type_name:
+                attrs[attr] = ""      # ambiguous: never resolves
+            else:
+                attrs[attr] = type_name
+
+        for info in self.functions.values():
+            if info.class_name is None:
+                continue
+            for node in own_nodes(info.node):
+                target = None
+                if isinstance(node, ast.Assign) \
+                        and len(node.targets) == 1:
+                    target = node.targets[0]
+                elif isinstance(node, ast.AnnAssign):
+                    target = node.target
+                if not (isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"):
+                    continue
+                attr = target.attr
+                if isinstance(node, ast.AnnAssign):
+                    # unresolvable annotations (containers, unions)
+                    # tombstone rather than silently keeping a type
+                    note(info.class_name, attr,
+                         _annotation_name(node.annotation) or "")
+                    continue
+                value = node.value
+                if isinstance(value, ast.Constant) \
+                        and value.value is None:
+                    continue   # `self.x = None` idles a typed attr
+                candidate = ""   # default: untypeable -> tombstone
+                if isinstance(value, ast.Name):
+                    # a rebind from an UNannotated name makes the attr
+                    # ambiguous — tombstone, don't keep a stale type
+                    candidate = info.annotations.get(value.id) or ""
+                elif isinstance(value, ast.Call):
+                    chain = dotted_chain(value.func)
+                    if chain is not None:
+                        last = chain.split(".")[-1]
+                        if last[:1].isupper():
+                            candidate = last
+                note(info.class_name, attr, candidate)
 
     def enclosing_function(self, line: int) -> Optional["FunctionInfo"]:
         """Innermost function whose def spans ``line`` (None = module
@@ -197,6 +307,9 @@ class ModuleInfo:
                         info.annotations[a.arg] = ann
                 info.decorators = list(stmt.decorator_list)
                 info.calls = _collect_calls(stmt)
+                info.bindings = _binding_counts(stmt)
+                info.aliases = _collect_aliases(info)
+                info.returns_chain = _returns_chain(stmt)
                 self.functions[qual] = info
                 self.scopes.setdefault(scope, {})[stmt.name] = qual
                 if class_name is not None and scope == class_name:
@@ -228,7 +341,120 @@ def _annotation_name(node) -> Optional[str]:
         return node.value.split(".")[-1].strip('"')
     if isinstance(node, ast.Attribute):
         return node.attr
+    if isinstance(node, ast.Subscript) \
+            and _annotation_name(node.value) == "Optional":
+        # Optional[X] types an attribute that idles at None — the
+        # `self._cur: Optional[SpoolCursor] = None` idiom
+        return _annotation_name(node.slice)
     return None
+
+
+def _binding_counts(func_node) -> Dict[str, int]:
+    """How many times each local name is BOUND in this function's own
+    body — a must-alias requires exactly one binding (loops, tuple
+    unpacks, walrus, with-as and except-as all count as bindings)."""
+    counts: Dict[str, int] = {}
+
+    def bump(target):
+        if isinstance(target, ast.Name):
+            counts[target.id] = counts.get(target.id, 0) + 1
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                bump(e)
+        elif isinstance(target, ast.Starred):
+            bump(target.value)
+
+    for node in own_nodes(func_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                bump(t)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                               ast.NamedExpr)):
+            bump(node.target)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            bump(node.target)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if item.optional_vars is not None:
+                    bump(item.optional_vars)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            counts[node.name] = counts.get(node.name, 0) + 1
+        elif isinstance(node, ast.comprehension):
+            bump(node.target)
+    return counts
+
+
+def _collect_aliases(info: "FunctionInfo") -> Dict[str, str]:
+    """``name -> chain`` for single-assignment locals bound from a
+    dotted chain: the ``lock = self._lock`` rebind that used to hide a
+    lock's identity from the passes."""
+    counts = info.bindings
+    out: Dict[str, str] = {}
+    for node in own_nodes(info.node):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name):
+            continue
+        chain = dotted_chain(node.value)
+        if chain is None or chain.split(".")[0] == t.id:
+            continue
+        if counts.get(t.id, 0) == 1 and t.id not in info.params:
+            out[t.id] = chain
+    return out
+
+
+def _returns_chain(func_node) -> Optional[str]:
+    """The one ``self.<attr>`` chain every return in the body returns,
+    or None — the returned-attribute accessor (``def lock(self):
+    return self._lock``) that lets ``obj.lock()`` denote the target
+    class's attribute in with-items."""
+    chains: Set[Optional[str]] = set()
+    saw_return = False
+    for node in own_nodes(func_node):
+        if isinstance(node, ast.Return):
+            saw_return = True
+            chains.add(dotted_chain(node.value)
+                       if node.value is not None else None)
+    if not saw_return or len(chains) != 1:
+        return None
+    chain = chains.pop()
+    if chain and chain.startswith("self.") and len(chain.split(".")) == 2:
+        return chain
+    return None
+
+
+def bind_args(callee: "FunctionInfo", call: ast.Call, chain: str,
+              index: Optional["ProjectIndex"] = None,
+              mod: Optional["ModuleInfo"] = None) -> Dict[str, ast.expr]:
+    """Map a resolved call's actual argument expressions onto the
+    callee's parameter names, the way Python would: positionals bind
+    only positional parameters (never keyword-only), excess
+    positionals fall into ``*args`` (unbindable — dropped, since a
+    wrong binding would fabricate a must-alias fact), and ``self`` is
+    skipped for attribute-form calls UNLESS the attribute base is a
+    class (an unbound ``Class.method(obj, ...)`` call binds ``self``
+    positionally). The seam that keeps an object flowing through
+    ``spill_pages(..., lock=ctx.lock)`` identified with the caller's
+    ``ctx.lock`` inside the callee."""
+    args_node = callee.node.args
+    pos_names = [a.arg for a in
+                 args_node.posonlyargs + args_node.args]
+    if pos_names and pos_names[0] in ("self", "cls") and "." in chain:
+        head = chain.split(".")[0]
+        unbound = (index is not None and mod is not None
+                   and index._class_site(mod, head) is not None)
+        if not unbound:
+            pos_names = pos_names[1:]
+    bound: Dict[str, ast.expr] = {}
+    for name, arg in zip(pos_names, call.args):
+        if isinstance(arg, ast.Starred):
+            break   # splat: everything from here is position-unknown
+        bound[name] = arg
+    for kw in call.keywords:
+        if kw.arg is not None:
+            bound[kw.arg] = kw.value
+    return bound
 
 
 def dotted_chain(node) -> Optional[str]:
@@ -333,16 +559,85 @@ class ProjectIndex:
 
     # -- resolution ------------------------------------------------------
 
+    def canonical_chain(self, info: Optional[FunctionInfo],
+                        chain: str) -> str:
+        """Expand leading single-assignment aliases in place:
+        ``lock.acquire`` with ``lock = self._lock`` canonicalizes to
+        ``self._lock.acquire`` (bounded — a pathological alias chain
+        stops expanding rather than looping)."""
+        if info is None:
+            return chain
+        for _ in range(5):
+            parts = chain.split(".")
+            expansion = info.aliases.get(parts[0])
+            if expansion is None:
+                return chain
+            chain = ".".join([expansion] + parts[1:])
+        return chain
+
+    def _class_site(self, mod: ModuleInfo,
+                    name: str) -> Optional[Tuple[str, str]]:
+        """(module, class) where ``name`` is defined, seen from
+        ``mod`` (local class or from-import)."""
+        if name in mod.classes:
+            return (mod.name, name)
+        if name in mod.from_imports:
+            target_mod, orig = mod.from_imports[name]
+            target = self.modules.get(target_mod)
+            if target is not None and orig in target.classes:
+                return (target_mod, orig)
+        return None
+
+    def instance_type(self, mod: ModuleInfo,
+                      info: Optional[FunctionInfo],
+                      parts: Sequence[str]
+                      ) -> Optional[Tuple[str, str]]:
+        """(module, class) of the INSTANCE a dotted chain denotes —
+        ``self`` / an annotated parameter at the head, then typed
+        attributes (``attr_types``) for every further hop. None
+        whenever any hop is unknown or ambiguous: must-alias only."""
+        head = parts[0]
+        if head in ("self", "cls"):
+            if info is None or not info.class_name:
+                return None
+            site: Optional[Tuple[str, str]] = (mod.name, info.class_name)
+        elif info is not None and head in info.annotations:
+            site = self._class_site(mod, info.annotations[head])
+        else:
+            return None
+        for attr in parts[1:]:
+            if site is None:
+                return None
+            owner = self.modules.get(site[0])
+            if owner is None:
+                return None
+            type_name = owner.attr_types.get(site[1], {}).get(attr)
+            if not type_name:
+                return None
+            site = self._class_site(owner, type_name)
+        return site
+
     def resolve(self, mod: ModuleInfo, info: Optional[FunctionInfo],
                 chain: str) -> Optional[str]:
         """Resolve a dotted call chain to a function id, or None."""
+        chain = self.canonical_chain(info, chain)
         parts = chain.split(".")
         head = parts[0]
         if head in ("self", "cls") and info is not None \
                 and info.class_name and len(parts) == 2:
-            return self._method(mod.name, info.class_name, parts[1])
+            hit = self._method(mod.name, info.class_name, parts[1])
+            if hit:
+                return hit
         if len(parts) == 1:
             return self._resolve_bare(mod, info, head)
+        # instance-typed resolution: self./annotated-param head plus
+        # typed-attribute hops (``self.ledger.park()``,
+        # ``pool.host_ledger.charge()``)
+        site = self.instance_type(mod, info, parts[:-1])
+        if site is not None:
+            hit = self._method(site[0], site[1], parts[-1])
+            if hit:
+                return hit
         # annotated parameter: other._lock-style method calls
         if info is not None and head in info.annotations \
                 and len(parts) == 2:

@@ -1,6 +1,6 @@
 """recompile pass: hazards that defeat program-cache reuse.
 
-Three rules, all instances of one failure mode — the cache key and the
+Two rules, both instances of one failure mode — the cache key and the
 traced program disagree, so the engine either retraces per page
 (interpreter-speed slide, the classic silent JAX perf bug) or serves a
 stale compiled program:
@@ -14,11 +14,12 @@ stale compiled program:
   ``TracerBoolConversionError`` or, with shape-dependent guards,
   retraces per distinct outcome. Attribute guards on ``.shape`` /
   ``.dtype`` / ``.ndim`` / ``len()`` are static and exempt.
-- ``cached-builder-reads-session``: a session-property read inside an
-  ``lru_cache``'d builder whose value is not part of the cache key —
-  the first call bakes one setting into the memoized program and later
-  sessions silently get it (the PR 5 ``min_collectives`` bug: fixed by
-  hoisting the read into the cache-key parameters).
+
+The third rule qlint shipped with (``cached-builder-reads-session``,
+the PR 5 ``min_collectives`` bug) moved to the ``cache-coherence``
+pass (round 14), which generalizes it beyond ``lru_cache`` to memo-
+dict builders, env vars and mutable globals — with interprocedural
+reach. ``_cached_functions`` stays here as the shared lru index.
 """
 
 from __future__ import annotations
@@ -33,7 +34,6 @@ PASS_ID = "recompile"
 
 _CACHE_CHAINS = {"lru_cache", "functools.lru_cache", "cache",
                  "functools.cache"}
-_SESSION_READ_LASTS = {"value", "prop_value"}
 _UNHASHABLE = (ast.Dict, ast.List, ast.Set, ast.DictComp, ast.ListComp,
                ast.SetComp, ast.GeneratorExp)
 _STATIC_ATTRS = {"shape", "dtype", "ndim", "size"}
@@ -118,32 +118,4 @@ def run(index: ProjectIndex) -> List[Finding]:
                     f"`{func.qualname}` — use lax.cond/jnp.where, "
                     f"or declare it static",
                     f"branch:{name}"))
-
-    # (c) session-property reads inside cached builders
-    for fid, builder in cached.items():
-        for call in builder.calls:
-            last = call.chain.split(".")[-1]
-            resolved = call.target or ""
-            is_read = resolved.endswith(
-                (":value", ":prop_value")) and \
-                "session_properties" in resolved
-            if not is_read and last in _SESSION_READ_LASTS:
-                head = call.chain.split(".")[0]
-                is_read = head in ("SP", "session_properties")
-            if not is_read:
-                continue
-            prop = ""
-            for a in call.node.args:
-                if isinstance(a, ast.Constant) \
-                        and isinstance(a.value, str):
-                    prop = a.value
-                    break
-            findings.append(Finding(
-                PASS_ID, "cached-builder-reads-session",
-                builder.module, builder.qualname, call.line,
-                f"lru_cache'd `{builder.qualname}` reads session "
-                f"property {prop or '<dynamic>'!r} not in its cache "
-                f"key — first caller's setting is baked into the "
-                f"memoized program",
-                f"session-read:{prop or call.chain}"))
     return findings

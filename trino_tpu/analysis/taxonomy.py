@@ -1,10 +1,12 @@
-"""taxonomy pass: every failure in ``parallel/`` is taxonomy-typed.
+"""taxonomy pass: every failure on the runtime paths is taxonomy-typed.
 
 PR 3's retry machinery dispatches on error TYPE (USER fails fast,
 infra faults consume the budget, INSUFFICIENT_RESOURCES escalates
 memory) — so an untyped failure is not a style problem, it changes
-recovery behaviour. Two rules, scoped to ``parallel/`` (fault.py
-itself is exempt: it defines the vocabulary):
+recovery behaviour. Two rules, scoped to ``parallel/``, ``telemetry/``
+and the serving cache (``cache.py``) — the three places an erased
+error type reaches retry dispatch or silently disables a surface
+(``fault.py`` itself is exempt: it defines the vocabulary):
 
 - ``bare-raise``: ``raise RuntimeError(...)`` / ``raise Exception(...)``
   — the coordinator classifies these INTERNAL by default, which makes
@@ -39,7 +41,10 @@ _FAULT_API = {"serialize_failure", "classify_exception",
 
 def _in_scope(name: str) -> bool:
     parts = name.split(".")
-    return "parallel" in parts[1:] and parts[-1] != "fault"
+    if parts[-1] == "fault":
+        return False   # defines the vocabulary
+    return ("parallel" in parts[1:] or "telemetry" in parts[1:]
+            or parts[-1] == "cache")
 
 
 def _raised_name(node: ast.Raise) -> Optional[str]:

@@ -742,6 +742,19 @@ class NodeMemoryPool:
                     max_bytes, spill_enabled, spill_to_disk,
                     parent=self, query_id=query_id)
                 self._children[query_id] = pool
+            else:
+                # a hit must not serve a stale configuration (the
+                # qlint cache-coherence class): a memory-aware retry
+                # re-admits with an ESCALATED budget while a straggling
+                # prior attempt still holds a pool ref — widen to the
+                # newest request instead of silently keeping the old
+                # limits
+                pool.max_bytes = max(pool.max_bytes, int(max_bytes))
+                pool.spill_enabled = pool.spill_enabled or spill_enabled
+                if spill_to_disk and not pool.spill_to_disk:
+                    pool.spill_to_disk = True
+                    if pool.disk_spiller is None:
+                        pool.disk_spiller = DiskSpiller(query_id)
             return pool
 
     def release_query(self, query_id: str):

@@ -194,7 +194,7 @@ def hash_segment_reduce(gid, group_rows, ngroups, key_raws: Tuple,
 
     cap = gid.shape[0]
     # state_cols is a tuple: pytree arity is trace-static, not traced
-    if pallas and state_cols:  # qlint: ignore[recompile]
+    if pallas and state_cols:  # qlint: ignore[recompile] tuple arity is pytree structure: trace-static, never a tracer bool
         ops = [gid] + list(state_cols)
         sorted_ = jax.lax.sort(ops, num_keys=1, is_stable=False)
         r_gid, r_states = sorted_[0], sorted_[1:]

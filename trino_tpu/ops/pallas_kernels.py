@@ -54,7 +54,8 @@ _WIN = _CHUNK + _LANE  # aligned window covering any chunk's segments
 def pallas_mode() -> str:
     """'tpu' (compiled), 'interpret' (forced, CPU), or '' (disabled)."""
     # trace-static mode switch: read once per compile, by design
-    forced = os.environ.get("TRINO_TPU_PALLAS", "")  # qlint: ignore[trace-purity]
+    forced = os.environ.get(  # qlint: ignore[trace-purity, cache-coherence] trace-static process-mode knob, read once per compile by design
+        "TRINO_TPU_PALLAS", "")
     if forced in ("0", "off"):
         return ""
     try:

@@ -1578,7 +1578,7 @@ class ProcessQueryRunner:
             attempt_id = f"{qid}.f{frag.fragment_id}.t{t}.spec"
             try:
                 status, _resp = attempt(t, attempt_id, worker)
-            except BaseException:  # qlint: ignore[taxonomy]
+            except BaseException:  # qlint: ignore[taxonomy] speculative loser: discarded by design
                 return  # a failed speculation never hurts the original
             if status == "win":
                 ctx.recovery.incr("speculative_wins")

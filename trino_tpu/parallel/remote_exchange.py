@@ -234,7 +234,7 @@ class RemoteExchangeChannel:
                 fired = self._bump_locked()
             for cb in fired:
                 cb()
-        except BaseException as e:  # qlint: ignore[taxonomy]
+        except BaseException as e:  # qlint: ignore[taxonomy] parked with type intact, re-raised in pages()
             # not a swallow: the error parks on the channel (with its
             # original type intact) and re-raises in the consumer's
             # pages() pull

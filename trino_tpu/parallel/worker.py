@@ -355,17 +355,25 @@ class WorkerServer:
 
     # ------------------------------------------------------------------
 
+    def _bump_pool_ref(self, qid: str):
+        with self._lock:
+            self._pool_refs[qid] = self._pool_refs.get(qid, 0) + 1
+
     def _acquire_query_pool(self, task_id: str, session: dict):
         """The per-query child of the node pool, refcounted by running
         tasks: concurrent tasks of one query share its QueryMemoryPool,
-        and the last release closes it (freeing spill files)."""
+        and the last release closes it (freeing spill files). The
+        session-property reads here are honored on EVERY acquire —
+        ``create_query_pool`` widens a hit's budget/spill config
+        instead of serving the first caller's settings stale (the
+        qlint cache-coherence class: a memory-aware retry re-admits
+        with an escalated budget while a straggler holds a ref)."""
         if self.node_pool is None:
             return None
         from .. import session_properties as SP
 
         qid = task_id.split(".", 1)[0]
-        with self._lock:
-            self._pool_refs[qid] = self._pool_refs.get(qid, 0) + 1
+        self._bump_pool_ref(qid)
         return self.node_pool.create_query_pool(
             qid,
             SP.prop_value(session, "query_max_memory_bytes"),
@@ -489,7 +497,7 @@ class WorkerServer:
         if kind == "error":
             # chaos harness: an injected crash must present as an
             # UNtyped generic failure — that is the class under test
-            raise RuntimeError(  # qlint: ignore[taxonomy]
+            raise RuntimeError(  # qlint: ignore[taxonomy] chaos harness: untyped crash IS the class under test
                 f"injected failure for task {task_id}")
         if kind == "user-error":
             from ..types import TrinoError
@@ -822,7 +830,7 @@ class WorkerServer:
         kind = fault.get("kind")
         if kind == "fail-after-publish":
             # chaos harness: deliberately untyped, like a real crash
-            raise RuntimeError(  # qlint: ignore[taxonomy]
+            raise RuntimeError(  # qlint: ignore[taxonomy] chaos harness: untyped crash IS the class under test
                 f"injected failure after spool publish for task "
                 f"{req['task_id']}")
         if kind == "truncate-spool":
