@@ -812,7 +812,14 @@ def _hbo_smoke() -> dict:
     Part B is the closed-loop witness: a join whose connector
     statistics lie by 7 orders of magnitude must flip to the matmul
     strategy on its second run via recorded history, byte-equal.
-    rc=13 when the flip or the equality fails."""
+    rc=13 when the flip or the equality fails.
+
+    The quantiles RATCHET against the committed ``hbo_qerror_p50`` /
+    ``hbo_qerror_p90`` cache entries: the workload is deterministic
+    (Q-error measures row counts, not wall time), so an optimizer
+    change that degrades estimate quality moves the quantiles — a
+    value above baseline x BENCH_HBO_RATCHET_MAX (default 1.25) emits
+    an ``hbo_qerror_*_regressed`` line and fails the run (same rc)."""
     _qlint_preflight()
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import jax
@@ -875,10 +882,12 @@ def _hbo_smoke() -> dict:
     first = r.execute(sql)
     flipped = "strategy=matmul" in r.explain(sql)
     second = r.execute(sql)
+    ratios, regressed = _qerror_ratchet(p50, p90, _load_cache())
     out = {
         "ok": bool(flipped and second.rows == first.rows
-                   and counters["records"] >= 4),
+                   and counters["records"] >= 4 and not regressed),
         "qerror_p50": p50, "qerror_p90": p90,
+        "qerror_regressed": regressed,
         "records": counters["records"],
         "nodes": counters["nodes"],
         "flipped": flipped,
@@ -886,15 +895,42 @@ def _hbo_smoke() -> dict:
         "wall_s": round(time.time() - t0, 2),
     }
     print(json.dumps({"metric": "hbo_qerror_p50", "value": p50,
-                      "unit": "qerror", "vs_baseline": 0.0}),
+                      "unit": "qerror",
+                      "vs_baseline": ratios["hbo_qerror_p50"]}),
           flush=True)
     print(json.dumps({"metric": "hbo_qerror_p90", "value": p90,
-                      "unit": "qerror", "vs_baseline": 0.0}),
+                      "unit": "qerror",
+                      "vs_baseline": ratios["hbo_qerror_p90"]}),
           flush=True)
+    for name in regressed:
+        print(json.dumps({"metric": f"{name}_regressed",
+                          "value": ratios[name],
+                          "unit": "x_vs_baseline",
+                          "vs_baseline": ratios[name]}), flush=True)
     print("HBO_RESULT " + json.dumps(out), flush=True)
     if not out["ok"]:
         raise SystemExit(13)
     return out
+
+
+def _qerror_ratchet(p50: float, p90: float, cache: dict):
+    """(vs-baseline ratios, regressed metric names) for the HBO
+    quantiles. Q-error is lower-better, so the check is an UPPER
+    bound: a quantile above its committed baseline x the tolerance
+    (BENCH_HBO_RATCHET_MAX, default 1.25) is an estimate-quality
+    regression. The workload is deterministic — Q-error measures row
+    counts, not wall time — so these cannot flake with host load.
+    No committed baseline -> ratio 0.0, never regressed."""
+    ceiling = float(os.environ.get("BENCH_HBO_RATCHET_MAX", "1.25"))
+    regressed = []
+    ratios = {}
+    for name, value in (("hbo_qerror_p50", p50),
+                        ("hbo_qerror_p90", p90)):
+        base = cache.get(name)
+        ratios[name] = round(value / base, 3) if base else 0.0
+        if base and ratios[name] > ceiling:
+            regressed.append(name)
+    return ratios, regressed
 
 
 def _qps_smoke():
@@ -1230,13 +1266,14 @@ def _qlint_preflight():
     qlint = _load_qlint()
     assert "jax" not in sys.modules, \
         "qlint pre-flight must not import jax in the bench parent"
-    # all eight passes must be registered (round 14 added
-    # cache-coherence + resource-lifecycle): a refactor that dropped a
-    # pass from the registry would silently weaken this gate
+    # all nine passes must be registered (round 14 added
+    # cache-coherence + resource-lifecycle, round 15 guarded-by): a
+    # refactor that dropped a pass from the registry would silently
+    # weaken this gate
     missing = {"trace-purity", "lock-order", "recompile",
                "session-props", "taxonomy", "blocked-protocol",
-               "cache-coherence",
-               "resource-lifecycle"} - set(qlint.PASSES)
+               "cache-coherence", "resource-lifecycle",
+               "guarded-by"} - set(qlint.PASSES)
     assert not missing, f"qlint passes missing from registry: {missing}"
 
     package = os.path.join(REPO, "trino_tpu")

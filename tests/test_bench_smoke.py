@@ -108,6 +108,39 @@ def test_bench_ratchet_flags_regression(capsys):
     assert not state4.get("regressed")
 
 
+def test_bench_hbo_qerror_ratchet():
+    """The HBO estimate-quality ratchet: quantiles above their
+    committed baseline x the tolerance regress (Q-error is
+    lower-better, so the bound is an UPPER one); no baseline = no
+    ratchet; the committed cache must actually carry the baselines."""
+    bench = _load_bench()
+    cache = {"hbo_qerror_p50": 2.0, "hbo_qerror_p90": 10.0}
+    # at baseline: ratio 1.0, clean
+    ratios, regressed = bench._qerror_ratchet(2.0, 10.0, cache)
+    assert ratios == {"hbo_qerror_p50": 1.0, "hbo_qerror_p90": 1.0}
+    assert regressed == []
+    # inside the tolerance: clean
+    _, regressed = bench._qerror_ratchet(2.4, 10.0, cache)
+    assert regressed == []
+    # beyond it: the regressed quantile is named
+    ratios, regressed = bench._qerror_ratchet(2.0, 20.0, cache)
+    assert regressed == ["hbo_qerror_p90"]
+    assert ratios["hbo_qerror_p90"] == 2.0
+    # BETTER estimates (lower qerror) never regress
+    _, regressed = bench._qerror_ratchet(1.0, 1.0, cache)
+    assert regressed == []
+    # no committed baseline: ratio 0.0, never regressed
+    ratios, regressed = bench._qerror_ratchet(99.0, 99.0, {})
+    assert ratios == {"hbo_qerror_p50": 0.0, "hbo_qerror_p90": 0.0}
+    assert regressed == []
+    # the REAL committed cache carries both baselines (the ratchet is
+    # armed, not latent)
+    committed = json.load(open(os.path.join(REPO,
+                                            ".bench_cpu_cache.json")))
+    assert committed.get("hbo_qerror_p50", 0) > 0
+    assert committed.get("hbo_qerror_p90", 0) > 0
+
+
 def test_bench_child_init_watchdog_fails_fast():
     """A measurement child whose backend init never completes must exit
     within seconds (distinct rc=3), not hang its whole 380 s budget —

@@ -615,6 +615,120 @@ def test_process_runner_worker_actuals_piggyback():
         runner.close()
 
 
+def test_seed_export_import_roundtrip_and_bounds():
+    """export_seed ships the MOST RECENT statements, bounded;
+    import_seed folds them into an empty (worker) store losslessly."""
+    src = RuntimeStatsStore()
+    for i in range(40):
+        src.record_query(f"s{i}", "snap",
+                         [{"fp": "n", "name": "Scan", "rows": float(i)}])
+    seed = src.export_seed(max_statements=8)
+    assert len(seed["statements"]) == 8
+    assert {s["fp"] for s in seed["statements"]} == \
+        {f"s{i}" for i in range(32, 40)}   # recency, not insertion
+    dst = RuntimeStatsStore()
+    assert dst.import_seed(seed) == 8
+    assert dst.counters()["statements"] == 8
+    h = dst.lookup("s39", "n", "snap")
+    assert h is not None and h.rows == 39.0
+
+
+def test_seed_existing_statements_win():
+    """A worker that already observed fresher actuals must not regress
+    to the coordinator's shipped EWMA."""
+    dst = RuntimeStatsStore()
+    dst.record_query("s", "snap",
+                     [{"fp": "n", "name": "Scan", "rows": 100.0}])
+    src = RuntimeStatsStore()
+    src.record_query("s", "snap",
+                     [{"fp": "n", "name": "Scan", "rows": 5.0}])
+    src.record_query("other", "snap",
+                     [{"fp": "n", "name": "Scan", "rows": 7.0}])
+    # the return value counts what was ACTUALLY imported: "s" already
+    # exists (kept), only "other" lands
+    assert dst.import_seed(src.export_seed()) == 1
+    assert dst.lookup("s", "n", "snap").rows == 100.0   # kept
+    assert dst.lookup("other", "n", "snap").rows == 7.0  # gained
+
+
+def test_seed_malformed_warns_and_imports_nothing():
+    dst = RuntimeStatsStore()
+    with pytest.warns(RuntimeWarning, match="hbo seed"):
+        ok = dst.import_seed({"statements": [{"fp": "x"}]})
+    assert not ok
+    assert dst.counters()["statements"] == 0
+    assert dst.counters()["corrupt_loads"] == 1
+
+
+def test_worker_configure_imports_seed_over_rpc():
+    """The real configure handler: an hbo_seed payload lands in the
+    worker-local store and the response reports the seeded count."""
+    import threading
+
+    from trino_tpu.parallel.rpc import call
+    from trino_tpu.parallel.worker import WorkerServer
+
+    src = RuntimeStatsStore()
+    src.record_query("seeded-stmt", "snap",
+                     [{"fp": "n", "name": "Scan", "rows": 3.0}])
+    stats_store.store().clear()
+    server = WorkerServer(0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        resp = call(("127.0.0.1", server.port), {
+            "op": "configure", "catalogs": {},
+            "properties": {}, "hbo_seed": src.export_seed()})
+        assert resp["ok"] and resp["hbo_seeded"] == 1
+        # in-process server shares this process's store: the seed is
+        # visible right here
+        assert stats_store.store().lookup("seeded-stmt", "n", "snap") \
+            is not None
+    finally:
+        server.server.shutdown()
+        stats_store.store().clear()
+
+
+def test_process_runner_ships_seed_and_binding_to_workers():
+    """E2E over real worker subprocesses: after the coordinator learns
+    a statement's actuals, a newly spawned (replacement-shaped) worker
+    receives the bounded history seed at configure — workers no longer
+    plan from nothing."""
+    from trino_tpu.parallel.process_runner import ProcessQueryRunner
+
+    catalogs = {"tpch": {"connector": "tpch", "page_rows": 4096}}
+    runner = ProcessQueryRunner(
+        catalogs, Session(catalog="tpch", schema="micro"),
+        n_workers=2, desired_splits=4)
+    new = None
+    try:
+        # the initial workers spawned against an empty store
+        assert all(w.hbo_seeded == 0 for w in runner.workers)
+        sql = ("select o_orderstatus, count(*) c from orders "
+               "group by o_orderstatus order by o_orderstatus")
+        res1 = runner.execute(sql)
+        assert res1.stats.get("hbo", {}).get("recorded", 0) > 0
+        # a worker spawned NOW (the replacement path) gets the learned
+        # history piggybacked on its configure
+        new = runner._spawn_worker_process(generation=1)
+        assert new.hbo_seeded >= 1
+        # and the run_task binding carries the statement key workers
+        # need to look that history up
+        from trino_tpu.parallel.process_runner import _QueryCtx
+        ctx = _QueryCtx(runner.session, "qtest")
+        from trino_tpu.telemetry.stats_store import HboContext
+        ctx.hbo = HboContext("fp", "snap", stats_store.store())
+        assert runner._hbo_binding(ctx) == {"stmt_fp": "fp",
+                                            "snap": "snap"}
+        ctx.hbo = None
+        assert runner._hbo_binding(ctx) is None
+        res2 = runner.execute(sql)
+        assert res2.rows == res1.rows
+    finally:
+        if new is not None:
+            new.proc.kill()
+        runner.close()
+
+
 def test_sidecar_survives_process_restart_simulation(tmp_path):
     path = str(tmp_path / "hbo.json")
     r = _join_runner(hbo_store_path=path)

@@ -232,6 +232,47 @@ def test_barrier_mode_task_retry():
         assert not any(c.failure_injections.values())
 
 
+def test_worker_snapshot_consistent_under_concurrent_replacement():
+    """The guarded-by fix: query-path readers copy the worker slots
+    under _heal_lock (`_worker_snapshot`) instead of iterating the
+    live list while the monitor thread swaps handles in place. A
+    snapshot taken during a storm of concurrent slot swaps must always
+    be a complete, valid view — never torn, never resized mid-read."""
+    import threading
+
+    r = ProcessQueryRunner.__new__(ProcessQueryRunner)  # no spawn
+    r._heal_lock = threading.Lock()
+    slots = [object() for _ in range(4)]
+    spares = [object() for _ in range(4)]
+    r.workers = list(slots)
+    valid = set(slots) | set(spares)
+
+    snap = r._worker_snapshot()
+    assert snap == r.workers and snap is not r.workers  # a COPY
+
+    stop = threading.Event()
+
+    def swapper():
+        i = 0
+        while not stop.is_set():
+            # the _replace_worker shape: in-place swap under the lock
+            with r._heal_lock:
+                r.workers[i % 4] = spares[i % 4] if i % 2 \
+                    else slots[i % 4]
+            i += 1
+
+    t = threading.Thread(target=swapper, daemon=True)
+    t.start()
+    try:
+        for _ in range(2000):
+            s = r._worker_snapshot()
+            assert len(s) == 4
+            assert all(w in valid for w in s)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+
+
 def test_serde_roundtrip():
     from trino_tpu import types as T
     from trino_tpu.block import Dictionary, Page

@@ -5,7 +5,8 @@ The engine carries several correctness invariants that exist only as
 prose in docstrings and PR descriptions; each was a hand-found bug
 once.  This package machine-checks them with stdlib ``ast`` (no JAX
 import, no new deps) over a shared module-index/call-graph core
-(``core.py``, alias-aware since round 14) and eight passes:
+(``core.py``, alias-aware since round 14, thread-entry-aware since
+round 15) and nine passes:
 
 - ``trace-purity`` — no host side-effects (spans, metrics, locks,
   ``time.*``, IO, ``print``) reachable inside jit'd/shard_map'd/Pallas
@@ -35,7 +36,16 @@ import, no new deps) over a shared module-index/call-graph core
 - ``resource-lifecycle`` — every constructed closeable (spool cursors,
   exchange channels, spillers, ``open()`` files) reaches ``close()``
   on all paths: ``with``, ``finally``, teardown-list registration or
-  ``weakref.finalize`` all count (the PR 8 leaked-cursor class).
+  ``weakref.finalize`` all count (the PR 8 leaked-cursor class);
+- ``guarded-by`` — Eraser-style lockset inference (round 15): a
+  thread-entry index (Thread/Timer targets, executor submits, RPC
+  handler methods, finalizer callbacks) plus interprocedural
+  must-alias locksets infer each attribute's guard from the lock held
+  at a qualifying majority of its mutating sites, then report bare
+  reads/writes from a DIFFERENT thread entry (the stats_store EWMA
+  merge / stream_results done-race / ProcessorCache ``_cache_lock``
+  class), with a check-then-act sub-rule for unlocked test-then-mutate
+  on shared containers.
 
 The shared core is alias-aware (round 14): single-assignment local
 rebinds, ``__init__``-typed ``self.*`` attributes, returned-attribute
@@ -106,6 +116,11 @@ def _pass_resource_lifecycle(index):
     return run(index)
 
 
+def _pass_guarded_by(index):
+    from .guarded_by import run
+    return run(index)
+
+
 #: pass slug -> runner(index) -> List[Finding]; slugs are the names
 #: used by --passes, pragmas and baseline keys
 PASSES = {
@@ -117,6 +132,7 @@ PASSES = {
     "blocked-protocol": _pass_blocked_protocol,
     "cache-coherence": _pass_cache_coherence,
     "resource-lifecycle": _pass_resource_lifecycle,
+    "guarded-by": _pass_guarded_by,
 }
 
 

@@ -145,7 +145,8 @@ def main(argv=None) -> int:
         description="qlint: repo-native static analysis "
                     "(trace-purity, lock-order, recompile, "
                     "session-props, taxonomy, blocked-protocol, "
-                    "cache-coherence, resource-lifecycle)")
+                    "cache-coherence, resource-lifecycle, "
+                    "guarded-by)")
     parser.add_argument("path", nargs="?", default=None,
                         help="package directory to analyze "
                              "(default: the trino_tpu package)")
@@ -199,13 +200,13 @@ def main(argv=None) -> int:
               "(drop --changed-since)", file=sys.stderr)
         return 2
 
-    index = ProjectIndex.from_package(package_path)
-    findings = run_passes(index, passes)
-
     repo_root = os.path.dirname(os.path.abspath(package_path))
-    changed_note = ""
+    changed = None
     if args.changed_since:
-        # diff paths are relative to the GIT top-level, which is not
+        # the git probe runs BEFORE the index build: a docs-only diff
+        # must not pay the full multi-second analysis in a pre-commit
+        # hook just to discover there was nothing to analyze. Diff
+        # paths are relative to the GIT top-level, which is not
         # necessarily the package's parent directory
         git_root = _git_toplevel(repo_root) or repo_root
         changed = _changed_files(git_root, args.changed_since)
@@ -214,7 +215,25 @@ def main(argv=None) -> int:
                   f"under {git_root}", file=sys.stderr)
             return 2
         repo_root = git_root
-        module_paths = _module_paths(index, git_root)
+        if not any(p.endswith(".py") for p in changed):
+            # a docs/config-only diff is NOT the same log line as an
+            # empty-findings clean run: say so explicitly so CI logs
+            # distinguish "nothing to analyze" from "analyzed, clean"
+            print(f"qlint: no analyzable changes — the diff since "
+                  f"{args.changed_since} touches no Python files "
+                  f"({len(changed)} file(s) changed)", file=sys.stderr)
+            if args.json:
+                print(json.dumps(to_sarif(
+                    package_path, passes or list(PASSES), [], [], [],
+                    {}), indent=1))
+            return 0
+
+    index = ProjectIndex.from_package(package_path)
+    findings = run_passes(index, passes)
+    module_paths = _module_paths(index, repo_root)
+
+    changed_note = ""
+    if changed is not None:
         before = len(findings)
         findings = [f for f in findings
                     if module_paths.get(f.module) in changed]
@@ -222,8 +241,6 @@ def main(argv=None) -> int:
                         f"{len(changed)} file(s), "
                         f"{before - len(findings)} finding(s) outside "
                         f"the diff]")
-    else:
-        module_paths = _module_paths(index, repo_root)
 
     baseline_path = args.baseline or default_baseline_path(package_path)
     baseline = {} if args.no_baseline else load_baseline(baseline_path)

@@ -498,6 +498,153 @@ def _collect_calls(func_node) -> List[CallSite]:
     return calls
 
 
+#: request-handler base classes whose methods run on server threads
+#: (stdlib socketserver / http.server dispatch)
+_HANDLER_BASES = {"BaseRequestHandler", "StreamRequestHandler",
+                  "DatagramRequestHandler", "BaseHTTPRequestHandler",
+                  "SimpleHTTPRequestHandler"}
+
+#: chains that construct a thread whose ``target=`` runs off-thread
+_THREAD_CTORS = {"threading.Thread", "Thread"}
+_TIMER_CTORS = {"threading.Timer", "Timer"}
+
+
+@dataclass(frozen=True)
+class ThreadEntry:
+    """One function that runs OFF the main thread, and how it gets
+    there — the entry half of the guarded-by pass's thread model."""
+    func_id: str
+    kind: str            # thread | timer | executor | rpc-handler |
+    #                      finalizer
+    spawn_module: str    # where the spawn/registration happens
+    spawn_line: int
+
+
+def _spawn_call(node: ast.Call,
+                chain: str) -> Optional[Tuple[str, Optional[ast.expr]]]:
+    """(entry kind, callable expression) when ``node`` hands work to
+    another thread — THE one spawn predicate, shared by the entry
+    index and the spawn-site map so the two can never drift. The
+    callable expr is None for a spawn whose target is absent."""
+    parts = chain.split(".")
+    if chain in _THREAD_CTORS:
+        target = None
+        for kw in node.keywords:
+            if kw.arg == "target":
+                target = kw.value
+        return "thread", target
+    if chain in _TIMER_CTORS and len(node.args) >= 2:
+        return "timer", node.args[1]
+    if parts[-1] == "submit" and len(parts) > 1 and node.args:
+        # executor-shaped: the callable is the first argument; a
+        # data-carrying .submit(obj) never resolves to a function,
+        # so it cannot enter the index
+        return "executor", node.args[0]
+    if parts[-1] == "finalize" and parts[0] in ("weakref", "finalize") \
+            and len(node.args) >= 2:
+        return "finalizer", node.args[1]
+    return None
+
+
+def _spawn_scan(index: "ProjectIndex"
+                ) -> Tuple[Dict[str, ThreadEntry],
+                           Dict[str, List[int]]]:
+    """ONE project walk feeding both spawn views: the thread-entry
+    index (resolved targets only — must-alias, an unresolvable
+    callable can never fabricate an entry; first spawn site wins) and
+    the per-function spawn-line map (every spawn-shaped call,
+    resolved or not — an unresolvable Thread target still publishes
+    ``self`` to another thread)."""
+    entries: Dict[str, ThreadEntry] = {}
+    spawns: Dict[str, List[int]] = {}
+
+    def add(target: Optional[str], kind: str, mod: "ModuleInfo",
+            line: int):
+        if target is not None and target in index.functions \
+                and target not in entries:
+            entries[target] = ThreadEntry(target, kind, mod.name, line)
+
+    for mod_name in sorted(index.modules):
+        mod = index.modules[mod_name]
+        # spawn call forms, anywhere in the module tree (module-level
+        # spawns live outside any FunctionInfo)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                bases = {(dotted_chain(b) or "").split(".")[-1]
+                         for b in node.bases}
+                if bases & _HANDLER_BASES:
+                    for name, qual in mod.classes.get(node.name,
+                                                      {}).items():
+                        if name == "handle" or name.startswith("do_"):
+                            add(f"{mod.name}:{qual}", "rpc-handler",
+                                mod, node.lineno)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            chain = dotted_chain(node.func)
+            if chain is None:
+                continue
+            hit = _spawn_call(node, chain)
+            if hit is None:
+                continue
+            kind, target_expr = hit
+            info = mod.enclosing_function(node.lineno)
+            if info is not None:
+                spawns.setdefault(info.id, []).append(node.lineno)
+            target_chain = dotted_chain(target_expr) \
+                if target_expr is not None else None
+            if target_chain is not None:
+                add(index.resolve(mod, info, target_chain), kind,
+                    mod, node.lineno)
+    return entries, spawns
+
+
+def thread_entries(index: "ProjectIndex") -> Dict[str, ThreadEntry]:
+    """Every function the project hands to another thread, keyed by
+    function id: ``threading.Thread(target=f)`` / ``Timer(..., f)``
+    targets, ``<executor>.submit(f, ...)`` callables, methods of
+    ``*RequestHandler`` subclasses (``handle`` / ``do_*`` run on server
+    threads), and ``weakref.finalize(obj, f, ...)`` callbacks (GC runs
+    them on whichever thread drops the last reference)."""
+    return _spawn_scan(index)[0]
+
+
+def thread_reachable(index: "ProjectIndex",
+                     entries: Optional[Dict[str, ThreadEntry]] = None
+                     ) -> Dict[str, Set[str]]:
+    """function id -> the set of thread-entry ids that reach it over
+    resolved call edges (entries reach themselves). Functions absent
+    from the map run only where their callers run — for a zero-in-edge
+    function, the main thread."""
+    if entries is None:
+        entries = thread_entries(index)
+    reached: Dict[str, Set[str]] = {}
+    for eid in sorted(entries):
+        stack = [eid]
+        while stack:
+            cur = stack.pop()
+            tags = reached.setdefault(cur, set())
+            if eid in tags:
+                continue
+            tags.add(eid)
+            func = index.functions.get(cur)
+            if func is None:
+                continue
+            for call in func.calls:
+                if call.target and call.target in index.functions:
+                    stack.append(call.target)
+    return reached
+
+
+def spawn_sites(index: "ProjectIndex") -> Dict[str, List[int]]:
+    """function id -> lines inside that function where a thread is
+    spawned/registered (the shared ``_spawn_call`` predicate). The
+    immutable-after-init exemption needs this: a ``self.x = ...`` in
+    ``__init__`` AFTER a spawn line already races the spawned
+    thread."""
+    return _spawn_scan(index)[1]
+
+
 class ProjectIndex:
     """All modules of one package, with cross-module call resolution."""
 

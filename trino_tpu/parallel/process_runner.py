@@ -283,7 +283,15 @@ class ProcessQueryRunner:
             self.metadata = Metadata(self.connectors)
         self.worker_replacement = worker_replacement
         self.heartbeat_interval = heartbeat_interval
+        #: slot indexes with a replacement in flight (guarded by
+        #: _heal_lock): concurrent heals claim before spawning, so one
+        #: dead worker never gets two replacements; releases notify
+        #: _heal_done so a heal that found its slots already claimed
+        #: can WAIT for the concurrent replacement instead of reporting
+        #: the slot dead
+        self._healing: set = set()
         self._heal_lock = threading.Lock()
+        self._heal_done = threading.Condition(self._heal_lock)
         self._closed = threading.Event()
         self.service = _CoordinatorService(self)
         self._spawn_workers()
@@ -318,22 +326,74 @@ class ProcessQueryRunner:
                              "GENERIC_INTERNAL_ERROR")
         port = int(line.split()[1])
         handle = WorkerHandle(proc, ("127.0.0.1", port), generation)
-        handle.rpc({"op": "configure",
-                    "catalogs": self.catalog_config,
-                    "properties": dict(self.session.properties)},
-                   timeout=60)
+        cfg = {"op": "configure",
+               "catalogs": self.catalog_config,
+               "properties": dict(self.session.properties)}
+        if SP.value(self.session, "hbo_enabled"):  # qlint: ignore[cache-coherence] _replace_worker's slot swap memo-matches a builder, but configure must see the LIVE flag (SET SESSION can flip hbo_enabled after construction)
+            # piggyback a bounded history snapshot: workers tag and
+            # report actuals but PLAN locally too (adaptive partial-agg
+            # seeding) — without this they plan from nothing, and a
+            # replacement worker spawned mid-life would forever lag
+            # the cluster's learned cardinalities
+            from ..telemetry.stats_store import store as _hbo_store
+
+            seed = _hbo_store().export_seed()
+            if seed["statements"]:
+                cfg["hbo_seed"] = seed
+        resp = handle.rpc(cfg, timeout=60)
+        #: statements the seed actually imported into the worker's
+        #: store (observability: tests + replacement-worker freshness)
+        handle.hbo_seeded = int(resp.get("hbo_seeded") or 0)
         return handle
 
     def _spawn_workers(self):
         for _ in range(self.n_workers):
-            self.workers.append(self._spawn_worker_process())
+            self.workers.append(self._spawn_worker_process())  # qlint: ignore[guarded-by] pre-publication: __init__ appends before the monitor thread exists
+
+    def _await_heal_drain(self, slots, note: str,
+                          stop_on_close: bool = False):
+        """Wait (bounded) until no claimed slot in ``slots`` (None =
+        any) remains in ``_healing`` — the one wait loop heal() and
+        close() share. The 300 s backstop only trips when a heal
+        thread died without running its claim-clearing ``finally``;
+        ``note`` is written to stderr then so the hang has a name."""
+        with self._heal_done:
+            deadline = time.time() + 300
+
+            def pending():
+                return self._healing if slots is None \
+                    else slots & self._healing
+
+            while pending() and time.time() < deadline:
+                if stop_on_close and self._closed.is_set():
+                    return
+                self._heal_done.wait(timeout=1.0)
+            if pending():
+                sys.stderr.write(note)
+
+    def _worker_snapshot(self) -> List[WorkerHandle]:
+        """Consistent copy of the worker slots for lock-free readers.
+        Replacement swaps handles IN PLACE under ``_heal_lock``
+        (``_replace_worker``); every reader that iterates the slots
+        without the lock copies through here, so it can never observe
+        a half-applied swap or race a concurrent ``list`` resize.
+        Callers must NOT hold ``_heal_lock`` (plain Lock)."""
+        with self._heal_lock:
+            return list(self.workers)
 
     def close(self):
         self._closed.set()
         if self._monitor_thread is not None:
             self._monitor_thread.join(timeout=10)
-        # serialize with any in-flight replacement (query-path heal):
-        # a spawn finishing after teardown must not orphan a process
+        # drain in-flight replacements BEFORE the kill sweep: the spawn
+        # runs outside the lock and re-checks _closed to reap its own
+        # process, but "closed" has always meant "no worker process
+        # survives this call" — returning mid-spawn would orphan the
+        # replacement
+        self._await_heal_drain(
+            None, "[close] in-flight worker replacement did not "
+                  "resolve within 300s; a replacement process may be "
+                  "orphaned\n")
         with self._heal_lock:
             for w in self.workers:
                 try:
@@ -396,7 +456,7 @@ class ProcessQueryRunner:
         conn = self.connectors[catalog]
         handle = conn.metadata().get_table_handle(schema, table)
         if handle is None:  # dropped: propagate the drop
-            for w in self.workers:
+            for w in self._worker_snapshot():
                 w.synced.pop(key, None)
                 if w.alive:
                     try:
@@ -408,7 +468,7 @@ class ProcessQueryRunner:
         data = conn.tables[(schema, table)]
         with data.lock:
             pages = list(data.pages)
-        for w in self.workers:
+        for w in self._worker_snapshot():
             if not w.alive:
                 continue
             self._sync_worker_table(w, catalog, schema, table,
@@ -459,7 +519,7 @@ class ProcessQueryRunner:
         piggybacks the worker's memory-pool snapshot into the
         ClusterMemoryManager (no extra RPC)."""
         ok = []
-        for i, w in enumerate(self.workers):
+        for i, w in enumerate(self._worker_snapshot()):
             memory = metrics = None
             try:
                 resp = w.rpc({"op": "ping"}, timeout=10)
@@ -472,6 +532,16 @@ class ProcessQueryRunner:
             w.alive = w.alive and alive and w.proc.poll() is None
             if was_alive and not w.alive:
                 w.failure_stats.record()
+            with self._heal_lock:
+                swapped = i >= len(self.workers) \
+                    or self.workers[i] is not w
+            if swapped:
+                # a heal replaced this slot MID-LOOP: the cluster
+                # memory/metrics keyed by i now belong to the live
+                # replacement — wiping them here would blind one
+                # governance tick for a healthy worker
+                ok.append(w.alive)
+                continue
             if w.alive:
                 self.cluster_memory.update(i, memory)
                 self.cluster_metrics.update(i, metrics)
@@ -485,23 +555,58 @@ class ProcessQueryRunner:
              reason: str = "on-demand") -> List[bool]:
         """Probe all workers and replace the dead ones (spawn + register
         + re-sync replicated tables): the self-healing step that keeps
-        cluster capacity from decaying to zero."""
+        cluster capacity from decaying to zero.
+
+        _heal_lock is held only to CLAIM dead slots and to SWAP the
+        finished replacement in — never across the spawn/configure/
+        re-sync work (seconds to a minute): query-path readers take
+        `_worker_snapshot()` on every candidate scan, and a heal that
+        held the lock for the whole replacement would stall every
+        in-flight query on one dead worker."""
         self.heartbeat()
         if self.worker_replacement:
             with self._heal_lock:
-                for i, w in enumerate(list(self.workers)):
-                    if not w.alive:
-                        self._replace_worker(i, reason, recovery)
-        return [w.alive for w in self.workers]
+                # claim dead slots so concurrent heals (monitor tick +
+                # query-path on-demand) never double-spawn for one slot
+                dead = []
+                busy = set()
+                for i, w in enumerate(self.workers):
+                    if w.alive:
+                        continue
+                    if i in self._healing:
+                        busy.add(i)
+                    else:
+                        dead.append(i)
+                self._healing.update(dead)
+            try:
+                for i in dead:
+                    self._replace_worker(i, reason, recovery)
+            finally:
+                with self._heal_done:
+                    self._healing.difference_update(dead)
+                    self._heal_done.notify_all()
+            # slots a CONCURRENT heal claimed: wait for those
+            # replacements to resolve (either way) before reporting —
+            # an on-demand heal racing the monitor tick must observe
+            # the outcome, not report the slot dead mid-spawn (the old
+            # whole-replacement lock gave callers exactly this wait)
+            if busy:
+                self._await_heal_drain(
+                    busy, "[heal] concurrent replacement did not "
+                          "resolve within 300s; reporting the slot "
+                          "as-is\n", stop_on_close=True)
+        return [w.alive for w in self._worker_snapshot()]
 
     def _replace_worker(self, index: int, reason: str,
                         recovery: Optional[RecoveryStats] = None):
-        """Spawn, register and re-sync a replacement for one dead worker
-        (caller holds _heal_lock). Failures leave the slot dead — the
-        next heal retries."""
-        if self._closed.is_set() or index >= len(self.workers):
-            return  # shutting down: don't spawn into a closed cluster
-        old = self.workers[index]
+        """Spawn, register and re-sync a replacement for one dead
+        worker (caller claimed the slot in ``_healing``). The slow work
+        runs OUTSIDE _heal_lock; only the final slot swap takes it.
+        Failures leave the slot dead — the next heal retries."""
+        with self._heal_lock:
+            if self._closed.is_set() or index >= len(self.workers):
+                return  # shutting down: don't spawn into a closed cluster
+            old = self.workers[index]
         if old.alive:
             return
         new = None
@@ -521,15 +626,19 @@ class ProcessQueryRunner:
                 except OSError:
                     pass
             return
-        if self._closed.is_set() or index >= len(self.workers):
+        with self._heal_lock:
+            torn_down = self._closed.is_set() \
+                or index >= len(self.workers)
+            if not torn_down:
+                # swap in-place: query threads snapshot self.workers
+                # and pick up the replacement on their next scan
+                self.workers[index] = new
+        if torn_down:
             try:                  # cluster torn down mid-spawn
                 new.proc.kill()
             except OSError:
                 pass
             return
-        # swap in-place: query threads iterate self.workers and pick up
-        # the replacement on their next candidate scan
-        self.workers[index] = new
         try:
             old.proc.kill()
         except OSError:
@@ -780,7 +889,7 @@ class ProcessQueryRunner:
             tot = snap["totals"]
             lines.append(
                 f"Kernels: {tot['programs']} programs over "
-                f"{1 + sum(1 for w in self.workers if w.alive)} "
+                f"{1 + sum(1 for w in self._worker_snapshot() if w.alive)} "
                 f"processes, {tot['compiles']} compiles "
                 f"(compile {tot['compile_ms']:.1f}ms)")
         return QueryResult(["Query Plan"], [T.VARCHAR],
@@ -800,7 +909,7 @@ class ProcessQueryRunner:
         dm = profiler.device_memory_stats()
         if dm:
             device_memory["coordinator"] = dm
-        for i, w in enumerate(self.workers):
+        for i, w in enumerate(self._worker_snapshot()):
             if not w.alive:
                 continue
             try:
@@ -926,7 +1035,7 @@ class ProcessQueryRunner:
                 # self-heal BEFORE deciding whether retry is possible:
                 # replacement restores capacity a bare heartbeat cannot
                 self.heal(ctx.recovery, reason="on-demand")
-                if not any(w.alive for w in self.workers):
+                if not any(w.alive for w in self._worker_snapshot()):
                     break
                 ctx.recovery.record_retry(e.error_type, query_level=True)
                 self._fire_retry(qid, e.error_type, attempt,
@@ -968,6 +1077,16 @@ class ProcessQueryRunner:
                 raise
         raise TrinoError(f"query failed after retry: {last_error}",
                          "GENERIC_INTERNAL_ERROR")
+
+    @staticmethod
+    def _hbo_binding(ctx: _QueryCtx):
+        """The statement-shape key a worker needs to LOOK UP history
+        in its configure-time seed (stmt fingerprint + connector
+        snapshot); None when hbo is off or the statement is
+        unversionable — the worker then tags without lookups."""
+        if ctx.hbo is None:
+            return None
+        return {"stmt_fp": ctx.hbo.stmt_fp, "snap": ctx.hbo.snap}
 
     def _collect_local_hbo(self, ctx: _QueryCtx, drivers):
         """Fold the coordinator-run output stage's fingerprint-tagged
@@ -1091,7 +1210,7 @@ class ProcessQueryRunner:
         overlap: Dict[str, bool] = {}
         try:
             for frag in fragments:
-                live = [w for w in self.workers if w.alive]
+                live = [w for w in self._worker_snapshot() if w.alive]
                 if not live:
                     raise _WorkerLost("no live workers")
                 if frag.output_kind == "output":
@@ -1152,6 +1271,7 @@ class ProcessQueryRunner:
                     "coordinator": self.service.addr,
                     "remote_write_catalogs": sorted(self._replicated),
                     "fault": self.fault_schedule.match(task_id),
+                    "hbo": self._hbo_binding(ctx),
                 }, launch_span, attempt=0)
                 try:
                     # full rpc_request_timeout: the streaming ack is
@@ -1338,7 +1458,7 @@ class ProcessQueryRunner:
         result_pages: List[Page] = []
         try:
             for frag in fragments:
-                live = [w for w in self.workers if w.alive]
+                live = [w for w in self._worker_snapshot() if w.alive]
                 if not live:
                     raise _WorkerLost("no live workers")
                 if frag.output_kind == "output":
@@ -1418,6 +1538,7 @@ class ProcessQueryRunner:
                 "remote_write_catalogs": sorted(self._replicated),
                 "spool_dir": spool_dir,
                 "fault": self.fault_schedule.match(attempt_id),
+                "hbo": self._hbo_binding(ctx),
             }
 
         def attempt(t: int, attempt_id: str, worker: WorkerHandle):
@@ -1488,9 +1609,13 @@ class ProcessQueryRunner:
                 for retry in range(self.task_retries + 1):
                     if done[t].is_set() or fatal:
                         return
-                    candidates = [w for w in self.workers
+                    # ONE snapshot for both scans: a heal swap landing
+                    # between two live iterations could mix a dead
+                    # handle with its replacement in the candidate set
+                    slots = self._worker_snapshot()
+                    candidates = [w for w in slots
                                   if w.alive and w not in tried] or \
-                        [w for w in self.workers if w.alive]
+                        [w for w in slots if w.alive]
                     if not candidates:
                         errors[t] = ("no live workers", EXTERNAL)
                         return
@@ -1550,7 +1675,7 @@ class ProcessQueryRunner:
             if results[t] is None:
                 msg, etype = errors[t] or ("task lost", EXTERNAL)
                 if "no live workers" not in msg \
-                        and all(w.alive for w in self.workers):
+                        and all(w.alive for w in self._worker_snapshot()):
                     raise _RetryableTaskError(
                         f"task {t} of fragment {frag.fragment_id} "
                         f"failed: {msg}", etype)
@@ -1618,7 +1743,7 @@ class ProcessQueryRunner:
                             or now - started[t] <= threshold:
                         continue
                     straggler = current_attempt.get(t)
-                    others = [w for w in self.workers if w.alive and
+                    others = [w for w in self._worker_snapshot() if w.alive and
                               (straggler is None or w is not straggler[0])]
                     if not others:
                         continue
@@ -1786,7 +1911,7 @@ class ProcessQueryRunner:
             len(self.event_manager.running()))
         reg.gauge("trino_workers_alive",
                   "Live worker processes").set(
-            sum(1 for w in self.workers if w.alive))
+            sum(1 for w in self._worker_snapshot() if w.alive))
         return self.cluster_metrics.collect(process_families()
                                             + reg.collect())
 
@@ -1795,7 +1920,7 @@ class ProcessQueryRunner:
         tracked by a live worker (running AND finished-but-unreleased),
         one poll per worker."""
         rows = []
-        for i, w in enumerate(self.workers):
+        for i, w in enumerate(self._worker_snapshot()):
             if not w.alive:
                 continue
             try:

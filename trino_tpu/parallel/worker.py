@@ -185,7 +185,17 @@ class WorkerServer:
                 or default_node_memory_bytes(),
                 host_spill_limit=SP.prop_value(
                     self.properties, "spill_host_memory_bytes"))
-            send_msg(sock, {"ok": True})
+            seeded = 0
+            if req.get("hbo_seed"):
+                # coordinator history piggybacks on configure: worker-
+                # local planning (adaptive partial-agg seeding) then
+                # sees the same cardinalities the coordinator planned
+                # from, instead of starting blind every process life
+                from ..telemetry import stats_store
+
+                seeded = stats_store.store().import_seed(
+                    req["hbo_seed"])
+            send_msg(sock, {"ok": True, "hbo_seeded": seeded})
         elif op == "run_task":
             send_msg(sock, self.run_task(req))
         elif op == "get_results":
@@ -718,13 +728,20 @@ class WorkerServer:
         hbo_on = SP.prop_value(session_props, "hbo_enabled")
         hbo_ctx = None
         if hbo_on:
-            # store-less binding: the worker only TAGS operators with
-            # node fingerprints; actuals ride the task response back to
-            # the coordinator's store (history lookups/seeds are a
-            # coordinator concern — it plans, workers execute)
+            # the worker TAGS operators with node fingerprints (actuals
+            # ride the task response back to the coordinator's store)
+            # AND, when the coordinator shipped the statement binding,
+            # READS the configure-time seed through the worker-local
+            # store — worker-side planning decisions (adaptive
+            # partial-agg seeding) then run from the same history the
+            # coordinator planned from. Binding absent = tag-only.
+            from ..telemetry import stats_store
             from ..telemetry.stats_store import HboContext
 
-            hbo_ctx = HboContext("", "", None)
+            binding = req.get("hbo") or {}
+            hbo_ctx = HboContext(
+                binding.get("stmt_fp", ""), binding.get("snap", ""),
+                stats_store.store() if binding else None)
         planner = LocalExecutionPlanner(
             metadata, req.get("desired_splits", 8),
             task_id=task_index, task_count=req["task_count"],

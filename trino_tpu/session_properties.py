@@ -10,14 +10,18 @@ Every declared property must have a read site and every literal
 lookup must be declared — machine-checked by the ``session-props``
 pass of ``python -m trino_tpu.analysis`` (a knob that validates but
 changes nothing, like the removed ``page_rows``, is a finding).
-Readers, per property:
+Readers, per property (re-verified against the pass's literal-lookup
+index at round 15 — rows list REGISTRY read sites; workers
+additionally consume several knobs straight off the session dict
+shipped on ``run_task`` via ``session_props.get(...)``, which the
+registry pass deliberately does not count):
 
 ========================================== ===========================
 property                                   read by
 ========================================== ===========================
 task_concurrency                           parallel/distributed.py
-desired_splits                             runner.py, parallel/worker.py,
-                                           parallel/process_runner.py
+desired_splits                             runner.py (workers receive
+                                           it in the task RPC payload)
 broadcast_join_threshold                   parallel/distributed.py,
                                            parallel/process_runner.py
 join_distribution_type                     parallel/distributed.py
@@ -30,8 +34,8 @@ node_max_memory_bytes                      parallel/worker.py
 query_max_total_memory,                    parallel/process_runner.py
 memory_killer_policy, retry_initial_memory
 scan_coalesce_enabled,                     runner.py,
-enable_dynamic_filtering,                  parallel/distributed.py,
-join_max_expand_lanes                      parallel/worker.py
+enable_dynamic_filtering,                  parallel/distributed.py
+join_max_expand_lanes                      (workers: shipped dict)
 filter_pushdown_enabled                    planner/rules.py,
                                            planner/optimizer.py
 streaming_execution,                       parallel/distributed.py,
@@ -39,8 +43,8 @@ exchange_max_pending_pages                 parallel/process_runner.py
 retry_policy, query_max_run_time,          parallel/process_runner.py
 retry_max_attempts, retry_*_backoff,
 speculation_*, query_tracing_enabled
-rpc_request_timeout                        parallel/process_runner.py,
-                                           parallel/worker.py
+rpc_request_timeout                        parallel/process_runner.py
+                                           (workers: shipped dict)
 hash_grouping_enabled,                     exec/local_planner.py
 adaptive_partial_aggregation_*             (grouping_options)
 device_exchange, device_exchange_sizing,   parallel/distributed.py
@@ -60,7 +64,6 @@ admission_batching_enabled,                server/protocol.py
 admission_batch_max
 query_profiling_enabled                    runner.py,
                                            parallel/distributed.py,
-                                           parallel/process_runner.py,
                                            parallel/worker.py
 slow_query_log_threshold                   runner.py,
                                            parallel/process_runner.py
@@ -69,7 +72,10 @@ hbo_enabled                                runner.py,
                                            parallel/distributed.py,
                                            parallel/process_runner.py,
                                            parallel/worker.py
-hbo_store_path, hbo_ewma_alpha             runner.py,
+hbo_store_path                             runner.py,
+                                           parallel/process_runner.py
+hbo_ewma_alpha                             runner.py,
+                                           parallel/distributed.py,
                                            parallel/process_runner.py
 ========================================== ===========================
 """

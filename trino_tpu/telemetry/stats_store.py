@@ -198,6 +198,31 @@ class NodeHistory:
                    int(d.get("runs", 0)), d.get("adaptive"))
 
 
+def _dump_statement(fp: str, st: dict) -> dict:
+    """JSON-safe form of one statement's history — the ONE shape the
+    sidecar (``save``/``load``) and the worker seed
+    (``export_seed``/``import_seed``) share; a field added here reaches
+    both transports, so they cannot silently drift."""
+    return {"fp": fp, "snap": st["snap"],
+            "scan_rows": st["scan_rows"],
+            "peak_bytes": st["peak_bytes"], "runs": st["runs"],
+            "nodes": [h.to_dict() for h in st["nodes"].values()]}
+
+
+def _parse_statement(s: dict):
+    """(fp, statement dict) back from ``_dump_statement`` output;
+    raises KeyError/ValueError/TypeError on malformed input — callers
+    decide whether that is a corrupt sidecar or a bad seed."""
+    return s["fp"], {
+        "snap": s["snap"],
+        "scan_rows": float(s.get("scan_rows", 0.0)),
+        "peak_bytes": float(s.get("peak_bytes", 0.0)),
+        "runs": int(s.get("runs", 0)),
+        "nodes": {n["fp"]: NodeHistory.from_dict(n)
+                  for n in s["nodes"]},
+    }
+
+
 # -- the store -------------------------------------------------------------
 
 
@@ -406,10 +431,7 @@ class RuntimeStatsStore:
         leaves the previous sidecar intact."""
         with self._lock:
             body = {"version": 1, "statements": [
-                {"fp": fp, "snap": st["snap"],
-                 "scan_rows": st["scan_rows"],
-                 "peak_bytes": st["peak_bytes"], "runs": st["runs"],
-                 "nodes": [h.to_dict() for h in st["nodes"].values()]}
+                _dump_statement(fp, st)
                 for fp, st in self._stmts.items()]}
         tmp = path + ".tmp"
         with open(tmp, "w") as f:
@@ -425,17 +447,10 @@ class RuntimeStatsStore:
         try:
             with open(path) as f:
                 body = json.load(f)
-            stmts = body["statements"]
             loaded: "OrderedDict[str, dict]" = OrderedDict()
-            for s in stmts:
-                loaded[s["fp"]] = {
-                    "snap": s["snap"],
-                    "scan_rows": float(s.get("scan_rows", 0.0)),
-                    "peak_bytes": float(s.get("peak_bytes", 0.0)),
-                    "runs": int(s.get("runs", 0)),
-                    "nodes": {n["fp"]: NodeHistory.from_dict(n)
-                              for n in s["nodes"]},
-                }
+            for s in body["statements"]:
+                fp, st = _parse_statement(s)
+                loaded[fp] = st
         except (ValueError, KeyError, TypeError, OSError) as e:
             with self._lock:
                 self.corrupt_loads += 1
@@ -447,6 +462,48 @@ class RuntimeStatsStore:
         with self._lock:
             self._stmts = loaded
         return True
+
+    # -- worker seeding ----------------------------------------------------
+
+    def export_seed(self, max_statements: int = 32) -> dict:
+        """Bounded, JSON-safe snapshot of the MOST RECENT statements —
+        the coordinator piggybacks this on worker ``configure()`` so
+        worker-local planning decisions (adaptive partial-agg seeding,
+        local strategy picks) see the same cardinalities the
+        coordinator planned from. Bounded by recency, not size-on-
+        disk: a replacement worker spawned mid-life gets the freshest
+        history, and the RPC payload stays small."""
+        with self._lock:
+            recent = list(self._stmts.items())[-max_statements:]
+            return {"version": 1, "statements": [
+                _dump_statement(fp, st) for fp, st in recent]}
+
+    def import_seed(self, payload: dict) -> int:
+        """Fold a coordinator seed into this (worker-local) store and
+        return how many statements it actually imported. Existing
+        statements win — a worker that already observed fresher
+        actuals must not regress to the coordinator's shipped EWMA
+        (those count 0). A malformed payload warns loudly and imports
+        nothing (the half-load rule ``load`` follows)."""
+        try:
+            loaded = [_parse_statement(s)
+                      for s in payload["statements"]]
+        except (ValueError, KeyError, TypeError) as e:
+            with self._lock:
+                self.corrupt_loads += 1
+            warnings.warn(
+                f"hbo seed payload is malformed and was IGNORED: "
+                f"{e!r}", RuntimeWarning, stacklevel=2)
+            return 0
+        imported = 0
+        with self._lock:
+            for fp, st in loaded:
+                if fp not in self._stmts:
+                    self._stmts[fp] = st
+                    imported += 1
+            while len(self._stmts) > self.max_statements:
+                self._stmts.popitem(last=False)
+        return imported
 
     def clear(self):
         with self._lock:
