@@ -952,7 +952,11 @@ def _qps_smoke():
     Env: BENCH_QPS_SCHEMA (micro|tiny, default tiny), BENCH_QPS_CLIENTS
     (default 8), BENCH_QPS_QUERIES (per client, default 25),
     BENCH_QPS_TENANTS (default 12), BENCH_QPS_RATCHET_MIN (default
-    0.6, applied to the speedup ratio)."""
+    0.6, applied to the speedup ratio).  Round 16 adds the
+    ``batch_launch_depth:<schema>`` ratchet: profiler-counted device
+    launches per statement for an 8-statement same-shape burst through
+    ``execute_batch`` — the single-launch vmapped path must keep this
+    under 1.0, and the committed baseline may only shrink."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           "/tmp/trino_tpu_jax_cache")
@@ -1060,7 +1064,6 @@ def _qps_smoke():
 
     off = run_phase("uncached", caches_on=False)
     on = run_phase("cached", caches_on=True)
-    counters = runner.query_cache.counters()
 
     # zero-retrace probe: a repeat statement through the warm plan/
     # processor caches must not trace anything (result cache off so the
@@ -1072,6 +1075,35 @@ def _qps_smoke():
     before = jit_stats.total()
     admin.execute(probe_sql)
     probe_traces = jit_stats.total() - before
+
+    # single-launch witness (round 16): an 8-statement same-shape burst
+    # through execute_batch must run each vmappable pipeline stage as
+    # ONE vmapped launch — the profiler counts launches independent of
+    # the batch depth B, so launches-per-statement is the ratchetable
+    # amortization metric (serial execution pays >= 1.0; a 2-stage
+    # fully batched pipeline over one scan page pays 2/8 = 0.25)
+    # the witness shape is filter/project (scan->fp*->collect): that is
+    # the vmappable pipeline class; the aggregating tiny_templates fall
+    # back to serial template riding by design (non_fp_stage)
+    from trino_tpu.telemetry import profiler as _prof
+    burst_tpl = ("select o_orderkey, o_totalprice from orders "
+                 "where o_custkey % 64 = {t}")
+    burst = [burst_tpl.format(t=t) for t in range(8)]
+    runner.execute_batch(burst, user="tenant-0")  # warm template+traces
+    # profiled re-run uses FRESH literals: same shape and padded depth,
+    # so it rides the warm template and traces, but misses the result
+    # cache — every member occupies a live vmap lane
+    burst2 = [burst_tpl.format(t=t) for t in range(8, 16)]
+    _prof.reset()
+    with _prof.profiling(True):
+        runner.execute_batch(burst2, user="tenant-0")
+        _snap = _prof.snapshot()
+    launches = sum(e["calls"] for e in _snap
+                   if e["name"] in ("page_processor",
+                                    "page_processor_batched"))
+    launch_depth = round(launches / len(burst), 4)
+    batched_launches = runner.query_cache.batched_launches
+    counters = runner.query_cache.counters()
 
     # bounded _QueryState growth: all delivered results must have been
     # popped; nothing may accumulate with sustained submissions
@@ -1090,13 +1122,26 @@ def _qps_smoke():
     speed_ratio = round(speedup / speed_base, 3) if speed_base else 0.0
     floor = float(os.environ.get("BENCH_QPS_RATCHET_MIN", "0.6"))
     regressed = bool(speed_base) and speed_ratio < floor
+    # launch-depth ratchet is STRICT (launch counts are deterministic
+    # for a fixed schema — no host-load noise to forgive): growing
+    # launches-per-statement means the vmapped path stopped amortizing
+    depth_base = cache.get(f"batch_launch_depth:{schema}")
+    depth_regressed = bool(depth_base) and launch_depth > depth_base
+    # template-eligible shapes ride the plan TEMPLATE (round 16), whose
+    # roots deliberately never enter the value-specialized plan cache —
+    # the "planning amortized" witness is the SUM of both reuse paths
+    plan_reuse = (counters["plan_hits"] + counters["plan_shape_hits"]
+                  + counters["template_hits"])
     ok = (on["queries"] == off["queries"] == n_clients * per_client
           and on["errors"] == 0 and off["errors"] == 0
-          and counters["plan_hits"] > 0
+          and plan_reuse > 0
           and probe_traces == 0
           and states_left <= 2 * n_clients
           and speedup >= min_speedup
-          and not regressed)
+          and batched_launches > 0
+          and launch_depth < 1.0
+          and not regressed
+          and not depth_regressed)
     out = {
         "ok": ok, "schema": schema, "clients": n_clients,
         "uncached": off, "cached": on, "speedup": speedup,
@@ -1105,7 +1150,11 @@ def _qps_smoke():
         "result_cache": {k: v for k, v in counters.items()
                          if k.startswith("result")},
         "batching": {k: counters[k] for k in
-                     ("batches", "batched_queries", "coalesced")},
+                     ("batches", "batched_queries", "coalesced",
+                      "batched_launches", "result_shortcircuits")},
+        "templates": {k: v for k, v in counters.items()
+                      if k.startswith("template")},
+        "batch_launch_depth": launch_depth,
         "probe_traces": probe_traces,
         "query_states_left": states_left,
         "wall_s": round(time.time() - t_start, 2),
@@ -1120,6 +1169,13 @@ def _qps_smoke():
         "metric": f"qps_{schema}_speedup_vs_uncached", "value": speedup,
         "unit": "x", "vs_baseline": speed_ratio,
         "uncached_qps": off["qps"], "uncached_p99_ms": off["p99_ms"],
+    }), flush=True)
+    print(json.dumps({
+        "metric": f"qps_{schema}_batch_launch_depth",
+        "value": launch_depth, "unit": "launches_per_statement",
+        "vs_baseline": (round(launch_depth / depth_base, 3)
+                        if depth_base else 0.0),
+        "batched_launches": batched_launches,
     }), flush=True)
     if regressed:
         print(json.dumps({

@@ -1434,6 +1434,10 @@ def test_gate_passes_are_not_blind_on_the_real_repo(repo_findings):
     assert any(e.kind == "shard_map" for e in entries.values())
     assert "trino_tpu.parallel.device_exchange:_exchange_program.prog" \
         in entries
+    # round 16: the vmapped batch entry (jax.jit(jax.vmap(_run, ...)))
+    # must stay inside the trace-purity walk — the vmap unwrapping in
+    # jit_entries is what keeps the batched path not-blind
+    assert "trino_tpu.expr.compiler:PageProcessor._run" in entries
     # the kernel-strategy entry points (round 12) must be inside the
     # trace-purity walk — the matmul probe, the global-hash claim loop,
     # and the per-key-range adaptive kernels are all hot jit'd code
@@ -1497,7 +1501,8 @@ def test_gate_passes_are_not_blind_on_the_real_repo(repo_findings):
     from trino_tpu.analysis.trace_purity import profiled_entries
     profiled = profiled_entries(index)
     assert len(profiled) >= 15, sorted(profiled)
-    for kernel in ("page_processor", "sort_by", "window_kernel",
+    for kernel in ("page_processor", "page_processor_batched",
+                   "sort_by", "window_kernel",
                    "hash_group_ids", "hash_segment_reduce",
                    "sort_group_reduce", "join_build_sorted",
                    "join_probe_counts", "join_expand_matches",

@@ -32,6 +32,9 @@ PASS_ID = "trace-purity"
 
 #: decorator / call chains that stage a Python function out to XLA
 _JIT_CHAINS = {"jax.jit", "jit", "jax.pmap", "pmap"}
+#: batching transforms that WRAP the staged function — the traced body
+#: is their first argument (``jax.jit(jax.vmap(f, ...))`` stages f)
+_VMAP_CHAINS = {"jax.vmap", "vmap"}
 _SHARD_CHAINS = {"shard_map", "jax.experimental.shard_map.shard_map"}
 _PALLAS_SUFFIX = "pallas_call"
 _PARTIAL_CHAINS = {"partial", "functools.partial"}
@@ -171,12 +174,20 @@ def jit_entries(index: ProjectIndex) -> Dict[str, EntryInfo]:
                 if kind is not None:
                     add(info, kind, statics)
             # call forms: jax.jit(f) / shard_map(f, ...) /
-            # pl.pallas_call(kernel, ...)
+            # pl.pallas_call(kernel, ...) / jax.jit(jax.vmap(f, ...))
             for call in info.calls:
                 kind = _stage_kind(call.chain)
                 if kind is None or not call.node.args:
                     continue
-                arg_chain = dotted_chain(call.node.args[0])
+                staged = call.node.args[0]
+                # unwrap batching transforms: the vmapped callable IS
+                # the traced body (its Python code runs at trace time)
+                while isinstance(staged, ast.Call) and staged.args \
+                        and dotted_chain(staged.func) is not None \
+                        and dotted_chain(staged.func).split(".")[-1] \
+                        in {c.split(".")[-1] for c in _VMAP_CHAINS}:
+                    staged = staged.args[0]
+                arg_chain = dotted_chain(staged)
                 if arg_chain is None:
                     continue
                 target = index.resolve(mod, info, arg_chain)

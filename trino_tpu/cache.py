@@ -380,6 +380,134 @@ class ResultCache:
             return len(self._entries)
 
 
+def literal_nodes(tokens) -> List[ast.Expression]:
+    """Rebuild the AST literal node each ``_literal_token`` came from —
+    the inverse of the tokenization, so template machinery can re-run
+    the ANALYZER's typing rules (decimal precision, varchar length,
+    DATE parsing under the session timezone) instead of duplicating
+    them."""
+    out: List[ast.Expression] = []
+    for tok in tokens:
+        kind = tok[0]
+        if kind == "long":
+            out.append(ast.LongLiteral(tok[1]))
+        elif kind == "double":
+            out.append(ast.DoubleLiteral(tok[1]))
+        elif kind == "decimal":
+            out.append(ast.DecimalLiteral(tok[1]))
+        elif kind == "string":
+            out.append(ast.StringLiteral(tok[1]))
+        else:
+            out.append(ast.GenericLiteral(tok[1], tok[2]))
+    return out
+
+
+def analyze_literal_tokens(tokens, session):
+    """Lower literal tokens to typed IR ``Literal``s via the analyzer
+    (one per token, in slot order).  Raises ``AnalysisError`` for
+    malformed generic literals — callers treat that as template
+    ineligibility."""
+    from .sql.analyzer import ExpressionAnalyzer, Scope
+
+    an = ExpressionAnalyzer(Scope([], None), session)
+    return [an.analyze(node) for node in literal_nodes(tokens)]
+
+
+class PlanTemplate:
+    """One value-independent optimized plan serving EVERY literal vector
+    of a statement shape (round 16).  ``param_types`` are the IR types
+    the template was planned against — a member whose analyzed literal
+    types differ (e.g. varchar(3) vs varchar(5), decimal scale drift)
+    must not ride it."""
+
+    __slots__ = ("root", "param_types", "scan_refs")
+
+    def __init__(self, root, param_types, scan_refs=()):
+        self.root = root
+        self.param_types = tuple(param_types)
+        self.scan_refs = tuple(scan_refs)
+
+
+class TemplateCache:
+    """Plan templates per (shape, session_fp, snapshot_fp, user) — the
+    full cache key MINUS literals.  Entries are positive (a
+    ``PlanTemplate``) or negative (a fallback-reason string: the shape
+    was tried and its planning genuinely depends on a literal value, so
+    per-statement planning is the loudly-counted answer and rebuild
+    attempts stop).  ``shape_uses`` feeds the admission policy: a shape
+    earns a template only after enough repeat uses (or an HBO hint)
+    prove the template build will amortize."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict" = OrderedDict()
+        self._shape_uses: Dict = {}
+        self.hits = 0
+        self.misses = 0
+        self.builds = 0
+        self.fallbacks: Dict[str, int] = {}
+
+    def lookup(self, key):
+        """-> ("hit", PlanTemplate) | ("fallback", reason) | None."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            return ("fallback", e) if isinstance(e, str) else ("hit", e)
+
+    def store(self, key, template: PlanTemplate, max_entries: int):
+        with self._lock:
+            self.builds += 1
+            self._entries[key] = template
+            self._entries.move_to_end(key)
+            while len(self._entries) > max(1, max_entries):
+                self._entries.popitem(last=False)
+
+    def store_fallback(self, key, reason: str, max_entries: int):
+        with self._lock:
+            self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+            self._entries[key] = reason
+            self._entries.move_to_end(key)
+            while len(self._entries) > max(1, max_entries):
+                self._entries.popitem(last=False)
+
+    def note_fallback(self, reason: str):
+        """Count a per-member/per-batch fallback that doesn't negative-
+        cache the whole key (e.g. one member's literal types drifted
+        from the template's — other members still ride it)."""
+        with self._lock:
+            self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
+
+    def note_uses(self, shape, n: int = 1) -> int:
+        """Count ``n`` submissions of ``shape``; returns the running
+        total (a batch of B counts as B uses — a same-shape burst is
+        exactly the evidence a template pays for)."""
+        with self._lock:
+            total = self._shape_uses.get(shape, 0) + n
+            self._shape_uses[shape] = total
+            if len(self._shape_uses) > 4096:
+                # bound the counter map: keep the hottest half
+                keep = sorted(self._shape_uses.items(),
+                              key=lambda kv: kv[1], reverse=True)[:2048]
+                self._shape_uses = dict(keep)
+            return total
+
+    def invalidate_shape(self, shape) -> int:
+        """HBO re-plan hook (mirrors ``PlanCache.invalidate_shape``)."""
+        with self._lock:
+            doomed = [k for k in self._entries if k[0] == shape]
+            for k in doomed:
+                del self._entries[k]
+            return len(doomed)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
+
+
 class QueryCache:
     """Per-runner facade: parse memo + plan cache + result cache +
     shared-processor cache, with one metrics surface.  Owned by
@@ -395,9 +523,12 @@ class QueryCache:
         self.plans = PlanCache()
         self.results = ResultCache(max_bytes=result_cache_bytes)
         self.processors = ProcessorCache()
+        self.templates = TemplateCache()
         self.coalesced = 0          # identical in-batch statements demuxed
         self.batches = 0            # admission batches executed
         self.batched_queries = 0    # statements that rode a batch
+        self.batched_launches = 0   # statements served by ONE vmapped launch
+        self.result_shortcircuits = 0  # batch members served from result cache
 
     def parse(self, sql: str, session) -> ParsedQuery:
         """Memoized parse + shape analysis (exact statement text).  The
@@ -434,6 +565,20 @@ class QueryCache:
         return (pq.shape, pq.literals, session_fingerprint(session),
                 snap, user or session.user)
 
+    def template_key(self, pq: ParsedQuery, session,
+                     user: Optional[str] = None) -> Optional[tuple]:
+        """Template cache key: the full key MINUS literals — one entry
+        serves every literal vector of the shape.  Same None rules as
+        ``cache_key`` (and additionally None for literal-free shapes:
+        with zero parameter slots the plan cache already covers them)."""
+        if not pq.is_query or not pq.literals:
+            return None
+        snap = snapshot_fingerprint(pq.catalogs, self.metadata)
+        if snap is None:
+            return None
+        return (pq.shape, session_fingerprint(session), snap,
+                user or session.user)
+
     def note_batch(self, size: int, coalesced: int):
         with self._lock:
             self.batches += 1
@@ -459,6 +604,13 @@ class QueryCache:
             "batches": self.batches,
             "batched_queries": self.batched_queries,
             "coalesced": self.coalesced,
+            "batched_launches": self.batched_launches,
+            "result_shortcircuits": self.result_shortcircuits,
+            "template_hits": self.templates.hits,
+            "template_misses": self.templates.misses,
+            "template_builds": self.templates.builds,
+            "template_fallbacks": sum(self.templates.fallbacks.values()),
+            "template_entries": len(self.templates),
         }
 
     def add_families(self, reg):
@@ -501,3 +653,16 @@ class QueryCache:
         b.inc(c["batches"], kind="batches")
         b.inc(c["batched_queries"], kind="queries")
         b.inc(c["coalesced"], kind="coalesced")
+        b.inc(c["batched_launches"], kind="vmapped")
+        b.inc(c["result_shortcircuits"], kind="result_shortcircuit")
+        t = reg.counter("trino_plan_template_total",
+                        "Plan-template lookups/builds by outcome "
+                        "(hit|miss|build|fallback:<reason>)")
+        t.inc(c["template_hits"], outcome="hit")
+        t.inc(c["template_misses"], outcome="miss")
+        t.inc(c["template_builds"], outcome="build")
+        for reason, n in sorted(self.templates.fallbacks.items()):
+            t.inc(n, outcome=f"fallback:{reason}")
+        reg.gauge("trino_plan_template_entries",
+                  "Plan-template resident entries (positive + "
+                  "negative)").set(c["template_entries"])

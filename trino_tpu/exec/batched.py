@@ -1,0 +1,144 @@
+"""Single-launch batched execution (round 16): run a same-shape
+admission burst through ONE vmapped device launch per pipeline stage.
+
+The serial batch path executes B same-shape statements as B separate
+walks of the shared compiled programs — B launches per stage where the
+programs differ only in the literal scalars they were called with.
+With plan templates (``cache.PlanTemplate``) the literals are opaque
+``ParamRef`` slots, so the per-stage program is ONE function of a
+parameter vector; stacking the burst's literal vectors on a leading
+``(B,)`` axis and ``vmap``-ing the stage (DrJAX-style lifting of the
+map over statements into the compiled program) executes the whole
+burst per scan page in a single launch, then demuxes member pages by
+slicing the batch axis.
+
+Eligibility here is narrower than template eligibility on purpose: a
+template whose local plan is anything richer than
+``scan -> filter/project* -> collect`` (joins, aggregations, limits,
+exchanges) still EXECUTES correctly through the shared template
+serially — zero retraces, B launches — it just doesn't vmap yet.
+``BatchIneligible.reason`` feeds the fallback taxonomy counters either
+way, so the gap is loud, not silent.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..block import DevicePage, Page
+from ..expr.compiler import param_raw
+from ..ops.operator import (FilterProjectOperator, OutputCollectorOperator,
+                            TableScanOperator)
+
+
+class BatchIneligible(Exception):
+    """This plan/batch cannot ride the vmapped path; ``reason`` is one
+    of the fallback-taxonomy tags documented in COMPONENTS.md."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+def vmappable_stages(plan) -> Tuple[TableScanOperator,
+                                    List[FilterProjectOperator]]:
+    """The (scan, filter/project stages) of a plan that can batch, or
+    raise ``BatchIneligible`` with the taxonomy reason."""
+    if len(plan.pipelines) != 1:
+        raise BatchIneligible("multi_pipeline")
+    ops = plan.pipelines[0].operators
+    if not ops or not isinstance(ops[0], TableScanOperator):
+        raise BatchIneligible("no_scan_head")
+    if not isinstance(ops[-1], OutputCollectorOperator):
+        raise BatchIneligible("no_collect_tail")
+    fps = ops[1:-1]
+    if not all(isinstance(o, FilterProjectOperator) for o in fps):
+        raise BatchIneligible("non_fp_stage")
+    return ops[0], list(fps)
+
+
+def check_params_consumed(fps: Sequence[FilterProjectOperator],
+                          num_params: int):
+    """Every literal slot of the shape must reach a compiled stage:
+    an unconsumed slot would mean two members with different literals
+    produce identical (wrong for one of them) results."""
+    consumed = set()
+    for fp in fps:
+        consumed.update(fp.processor.param_indices)
+    if consumed != set(range(num_params)):
+        raise BatchIneligible("params_unconsumed")
+
+
+def stack_bindings(fps: Sequence[FilterProjectOperator], param_types,
+                   bindings: Sequence[Tuple]) -> List[Tuple]:
+    """Per-stage stacked parameter tensors: for each stage, a tuple
+    (one entry per consumed slot, in ``param_indices`` order) of
+    ``(D,)`` arrays over the padded batch ``bindings`` (python literal
+    values per global slot, one tuple per batch lane)."""
+    out = []
+    for fp in fps:
+        idxs = fp.processor.param_indices
+        out.append(tuple(
+            np.stack([np.asarray(param_raw(param_types[i], vals[i]))
+                      for vals in bindings])
+            for i in idxs))
+    return out
+
+
+def execute_batched(plan, param_types, bindings: Sequence[Tuple],
+                    num_members: int) -> List[List[Page]]:
+    """Drive the plan's single scan->fp*->collect pipeline with the
+    whole padded batch in one launch per stage per scan page.
+
+    ``bindings`` is the PADDED batch (length D >= num_members); result
+    pages demux positionally for the first ``num_members`` lanes only.
+    Returns host pages per member, byte-equal to running each member
+    through the serial path (same programs, same rawness — the padding
+    lanes compute and are discarded)."""
+    scan, fps = vmappable_stages(plan)
+    check_params_consumed(fps, len(param_types))
+    stage_params = stack_bindings(fps, param_types, bindings)
+    out_pages: List[List[Page]] = [[] for _ in range(num_members)]
+    while True:
+        dpage = scan.get_output()
+        if dpage is None:
+            if scan.is_finished():
+                break
+            continue
+        cols = tuple(dpage.cols)
+        nulls = tuple(dpage.nulls)
+        valid = dpage.valid
+        dicts = dpage.dictionaries
+        batched = False
+        out_types = dpage.types
+        for fp, params in zip(fps, stage_params):
+            proc = fp.processor
+            if not batched and not params:
+                # param-free prefix stage: members are identical here —
+                # one UNBATCHED launch shared by the whole burst
+                dp = proc.process(DevicePage(list(out_types), list(cols),
+                                             list(nulls), valid,
+                                             list(dicts)))
+                cols, nulls, valid = (tuple(dp.cols), tuple(dp.nulls),
+                                      dp.valid)
+                dicts = dp.dictionaries
+            else:
+                mode = "carried" if batched else "shared"
+                cols, nulls, valid, dicts = proc.process_batched(
+                    cols, nulls, valid, dicts, params, mode)
+                batched = True
+            out_types = proc.output_types
+        if not batched:
+            # cannot happen after check_params_consumed with
+            # param_types non-empty; guard for the zero-literal case
+            raise BatchIneligible("params_unconsumed")
+        for b in range(num_members):
+            member = DevicePage(
+                list(out_types), [c[b] for c in cols],
+                [n[b] for n in nulls], valid[b], list(dicts))
+            host = member.to_page()
+            if host.num_rows:
+                out_pages[b].append(host)
+    return out_pages

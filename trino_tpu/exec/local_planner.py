@@ -133,7 +133,8 @@ class LocalExecutionPlanner:
                  adaptive_partial_min_rows: int = ADAPTIVE_MIN_ROWS,
                  adaptive_partial_buckets: int = ADAPTIVE_KEY_BUCKETS,
                  matmul_max_key_range: int = 1024,
-                 processor_cache=None, progress=None, hbo=None):
+                 processor_cache=None, progress=None, hbo=None,
+                 params=None):
         self.metadata = metadata
         self.desired_splits = desired_splits
         self.task_id = task_id
@@ -174,6 +175,12 @@ class LocalExecutionPlanner:
         #: fingerprint (actuals recording) and partial aggregations
         #: seed their adaptive verdicts from recorded history
         self.hbo = hbo
+        #: template-parameter bindings (round 16): GLOBAL literal-slot
+        #: index -> raw device scalar.  A template plan's IR carries
+        #: opaque ParamRefs; this map binds them for ONE statement so
+        #: the shared compiled programs run without retracing.  None/{}
+        #: for ordinary (literal-baked) plans.
+        self._params = dict(params or {})
         self.pipelines: List[PhysicalPipeline] = []
         # scan-node id -> [(channel, DynamicFilter)] attachments
         self._scan_dfs: Dict[int, List] = {}
@@ -189,6 +196,20 @@ class LocalExecutionPlanner:
                                             filter_expr)
         return PageProcessor(list(input_types), list(projections),
                              filter_expr)
+
+    def _params_for(self, proc: PageProcessor) -> tuple:
+        """This statement's raw bindings for the slots ``proc``
+        consumes, in ``proc.param_indices`` order (a missing binding is
+        a planner bug: the template/member contract guarantees the full
+        literal vector)."""
+        if not proc.param_indices:
+            return ()
+        return tuple(self._params[i] for i in proc.param_indices)
+
+    def _fp_operator(self, input_types, projections,
+                     filter_expr=None) -> FilterProjectOperator:
+        proc = self._processor(input_types, projections, filter_expr)
+        return FilterProjectOperator(proc, self._params_for(proc))
 
     def _mem_ctx(self, name: str):
         if self.memory_pool is None:
@@ -210,8 +231,7 @@ class LocalExecutionPlanner:
                        for s in root.outputs]
         if [p.channel for p in projections] != list(range(len(types_))) or \
                 len(projections) != len(types_):
-            ops.append(FilterProjectOperator(
-                self._processor(types_, projections)))
+            ops.append(self._fp_operator(types_, projections))
         sink = OutputCollectorOperator()
         ops.append(sink)
         self.pipelines.append(PhysicalPipeline(ops))
@@ -278,15 +298,13 @@ class LocalExecutionPlanner:
         ops, layout, types_ = self.visit(node.source)
         pred = to_input_refs(node.predicate, layout)
         projections = [InputRef(t, i) for i, t in enumerate(types_)]
-        ops.append(FilterProjectOperator(
-            self._processor(types_, projections, pred)))
+        ops.append(self._fp_operator(types_, projections, pred))
         return ops, layout, types_
 
     def _v_ProjectNode(self, node: ProjectNode):
         ops, layout, types_ = self.visit(node.source)
         projections = [to_input_refs(e, layout) for _, e in node.assignments]
-        ops.append(FilterProjectOperator(
-            self._processor(types_, projections)))
+        ops.append(self._fp_operator(types_, projections))
         new_layout = {s.name: i for i, (s, _) in enumerate(node.assignments)}
         return ops, new_layout, [s.type for s, _ in node.assignments]
 
@@ -376,7 +394,10 @@ class LocalExecutionPlanner:
                 combined_types,
                 [InputRef(t, i) for i, t in enumerate(combined_types)],
                 pred)
-            filter_fn = proc.process
+            jparams = self._params_for(proc)
+
+            def filter_fn(dp, _proc=proc, _params=jparams):
+                return _proc.process(dp, _params)
 
         if strategy == "matmul":
             # the cost model picked the blocked one-hot matmul probe;
@@ -430,8 +451,7 @@ class LocalExecutionPlanner:
             want = [layout[s.name] for s in in_syms]
             if want != list(range(len(want))) or len(want) != len(types_):
                 proj = [InputRef(types_[c], c) for c in want]
-                ops.append(FilterProjectOperator(
-                    self._processor(types_, proj)))
+                ops.append(self._fp_operator(types_, proj))
                 types_ = [types_[c] for c in want]
                 layout = {s.name: i for i, s in enumerate(in_syms)}
                 group_channels = list(range(len(node.group_keys)))
@@ -513,8 +533,7 @@ class LocalExecutionPlanner:
             projections = [InputRef(s.type, clayout[cs.name])
                            for s, cs in zip(node.symbols,
                                             child.output_symbols)]
-            cops.append(FilterProjectOperator(
-                self._processor(ctypes, projections)))
+            cops.append(self._fp_operator(ctypes, projections))
             sink = OutputCollectorOperator()
             cops.append(sink)
             self.pipelines.append(PhysicalPipeline(cops))

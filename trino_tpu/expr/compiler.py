@@ -30,7 +30,19 @@ from .. import types as T
 from ..block import DevicePage, Dictionary, padded_size
 from ..types import TrinoError, TypeError_
 from . import functions as F
-from .ir import Call, InputRef, Literal, RowExpression
+from .ir import Call, InputRef, Literal, ParamRef, RowExpression
+
+
+def param_raw(t: T.Type, v):
+    """Python literal value -> raw device scalar under type ``t`` (the
+    same lowering ``_literal_raw`` bakes at trace time — template
+    parameters must bind to bit-identical rawness or the batched path
+    diverges from the serial oracle)."""
+    if t.is_decimal:
+        return np.int64(t.to_raw(v))
+    if t == T.BOOLEAN:
+        return np.bool_(v)
+    return np.asarray(v, dtype=t.storage)[()]
 
 
 def _is_string(t: T.Type) -> bool:
@@ -99,10 +111,33 @@ class PageProcessor:
         # expressions whose output pool is built per process() call
         # (string CASE/COALESCE merge branch pools)
         self._out_dict_resolvers: Dict[int, object] = {}
+        # template parameter slots (round 16): ParamRefs in the IR bind
+        # to traced inputs instead of baked constants.  param_indices is
+        # the sorted tuple of GLOBAL literal-slot indices this program
+        # consumes; callers pass bindings in that order.
+        exprs = ([filter_expr] if filter_expr is not None else []) \
+            + self.projections
+        self._param_types: Dict[int, T.Type] = {}
+
+        def note_params(e):
+            if isinstance(e, ParamRef):
+                self._param_types[e.index] = e.type
+            elif isinstance(e, Call):
+                for a in e.args:
+                    note_params(a)
+
+        for e in exprs:
+            note_params(e)
+        self.param_indices: Tuple[int, ...] = tuple(
+            sorted(self._param_types))
+        self._param_pos = {idx: pos for pos, idx
+                           in enumerate(self.param_indices)}
+        #: lazily-built vmapped programs per batch mode ("shared" |
+        #: "carried") — lazy so param-free processors never pay for or
+        #: perturb the serial program registry
+        self._batched_jits: Dict[str, object] = {}
         # plan every expression once (assigns slots deterministically)
-        self._plans = [self._plan(e) for e in
-                       ([filter_expr] if filter_expr is not None else [])
-                       + self.projections]
+        self._plans = [self._plan(e) for e in exprs]
         if filter_expr is not None:
             self._filter_plan = self._plans[0]
             self._proj_plans = self._plans[1:]
@@ -267,6 +302,17 @@ class PageProcessor:
             raw = self._literal_raw(e)
             return lambda env: (jnp.asarray(raw), None)
 
+        if isinstance(e, ParamRef):
+            if _is_pooled(e.type):
+                # pooled params would need per-member host pools —
+                # template build treats this shape as ineligible
+                raise TypeError_(
+                    f"unsupported string expression {e!r}")
+            pos = self._param_pos[e.index]
+            # cache-marked literals are never NULL (NullLiteral stays in
+            # the shape), so the mask is statically absent
+            return lambda env: (env["params"][pos], None)
+
         assert isinstance(e, Call), e
         name = e.name
 
@@ -394,12 +440,7 @@ class PageProcessor:
     # -- helpers -------------------------------------------------------
 
     def _literal_raw(self, e: Literal):
-        t, v = e.type, e.value
-        if t.is_decimal:
-            return np.int64(t.to_raw(v))
-        if t == T.BOOLEAN:
-            return np.bool_(v)
-        return np.asarray(v, dtype=t.storage)[()]
+        return param_raw(e.type, e.value)
 
     def _plan_default_call(self, e: Call, fn: F.ScalarFunction):
         plans = [self._plan(a) for a in e.args]
@@ -836,11 +877,12 @@ class PageProcessor:
         # sharing this processor must serialize only the cache lookups
         return tuple(jnp.asarray(a) for a in arrs)
 
-    def _run(self, cols, nulls, valid, luts):
+    def _run(self, cols, nulls, valid, luts, params=()):
         from .. import jit_stats
 
         jit_stats.bump("page_processor")  # trace-time only (cache miss)
-        env = {"cols": cols, "nulls": nulls, "luts": luts}
+        env = {"cols": cols, "nulls": nulls, "luts": luts,
+               "params": params}
         new_valid = valid
         if self._filter_plan is not None:
             r, n = self._filter_plan(env)
@@ -855,15 +897,67 @@ class PageProcessor:
             out_nulls.append(n)
         return tuple(out_cols), tuple(out_nulls), new_valid
 
-    def process(self, dpage: DevicePage) -> DevicePage:
+    def process(self, dpage: DevicePage, params: Tuple = ()) -> DevicePage:
         dicts = dpage.dictionaries
         luts = self._fill_luts(dicts)
         cols, nulls, valid = self._jit(
-            tuple(dpage.cols), tuple(dpage.nulls), dpage.valid, luts)
+            tuple(dpage.cols), tuple(dpage.nulls), dpage.valid, luts,
+            params)
         with self._cache_lock:
             out_dicts = self._resolve_out_dicts(dicts)
         return DevicePage(self.output_types, list(cols), list(nulls), valid,
                           out_dicts)
+
+    # -- batched execution (round 16) ----------------------------------
+
+    def _batched_jit(self, mode: str):
+        """One jitted ``vmap(_run)`` per batch mode, built lazily.
+
+        "shared": stage 1 of a burst — the scan page is SHARED across
+        the batch (no leading axis); only the parameter bindings carry
+        the ``(B,)`` axis, and vmap broadcasts the page once on device.
+        "carried": downstream stages — data already has the ``B`` axis
+        from the previous stage.  LUTs are value-independent of params
+        (string params are template-ineligible) so they never batch.
+        """
+        with self._cache_lock:
+            fn = self._batched_jits.get(mode)
+        if fn is not None:
+            return fn
+        from ..telemetry.profiler import instrument
+
+        ax = None if mode == "shared" else 0
+        fn = instrument(
+            "page_processor_batched",
+            jax.jit(jax.vmap(self._run, in_axes=(ax, ax, ax, None, 0))),
+            key=(mode, tuple(self.input_types), tuple(self.projections),
+                 self.filter_expr))
+        with self._cache_lock:
+            self._batched_jits.setdefault(mode, fn)
+            return self._batched_jits[mode]
+
+    def bind_params(self, values: Tuple) -> Tuple:
+        """Raw bindings for ONE statement, ordered by this program's
+        consumed slots.  ``values`` holds the python literal value per
+        GLOBAL slot index (the shape's full literal vector)."""
+        return tuple(
+            param_raw(self._param_types[i], values[i])
+            for i in self.param_indices)
+
+    def process_batched(self, cols, nulls, valid, dicts, params_batch,
+                        mode: str = "shared"):
+        """Run the whole ``(B,)`` burst as ONE device launch.
+
+        ``params_batch`` is a tuple (one entry per consumed slot, in
+        ``param_indices`` order) of stacked ``(B,)`` arrays.  Returns
+        ``(cols, nulls, valid, out_dicts)`` with a leading batch axis on
+        every device array — the caller demuxes per statement."""
+        luts = self._fill_luts(dicts)
+        out_cols, out_nulls, new_valid = self._batched_jit(mode)(
+            cols, nulls, valid, luts, params_batch)
+        with self._cache_lock:
+            out_dicts = self._resolve_out_dicts(dicts)
+        return out_cols, out_nulls, new_valid, out_dicts
 
     def _resolve_out_dicts(self, dicts) -> List[Optional[Dictionary]]:
         """Output dictionary per projection (caller holds _cache_lock:

@@ -43,6 +43,26 @@ class Literal(RowExpression):
 
 
 @dataclass(frozen=True)
+class ParamRef(RowExpression):
+    """Opaque plan-template parameter: the i-th cache-marked literal of a
+    normalized statement shape (``cache.normalize_statement``).
+
+    Deliberately NOT a ``Literal`` subclass — every plan-time constant
+    reader (constant folding, domain translation, rank bounds) is
+    ``isinstance(_, Literal)``-gated, so a ParamRef is opaque by
+    construction: one optimized plan serves every literal vector of the
+    shape.  The compiler binds it to a traced input slot instead of a
+    baked constant, which is what lets a same-shape batch ``vmap`` over
+    the stacked literal axis.
+    """
+
+    index: int = 0
+
+    def __repr__(self):
+        return f"param({self.index}):{self.type}"
+
+
+@dataclass(frozen=True)
 class Call(RowExpression):
     name: str = ""
     args: Tuple[RowExpression, ...] = ()
@@ -58,6 +78,21 @@ def input_channels(expr: RowExpression) -> set:
     def walk(e):
         if isinstance(e, InputRef):
             out.add(e.channel)
+        elif isinstance(e, Call):
+            for a in e.args:
+                walk(a)
+
+    walk(expr)
+    return out
+
+
+def param_indices(expr: RowExpression) -> set:
+    """All template-parameter indices referenced by an expression tree."""
+    out = set()
+
+    def walk(e):
+        if isinstance(e, ParamRef):
+            out.add(e.index)
         elif isinstance(e, Call):
             for a in e.args:
                 walk(a)

@@ -166,8 +166,12 @@ class FilterProjectOperator(Operator):
     ScanFilterAndProjectOperator / FilterAndProjectOperator +
     operator/project/PageProcessor.java)."""
 
-    def __init__(self, processor: PageProcessor):
+    def __init__(self, processor: PageProcessor, params: tuple = ()):
         self.processor = processor
+        #: template-parameter bindings (round 16): raw scalars for the
+        #: processor's consumed slots — a template plan executed for one
+        #: statement binds its literal vector here instead of retracing
+        self.params = tuple(params)
         self._pending: Optional[DevicePage] = None
         self._done = False
 
@@ -176,7 +180,7 @@ class FilterProjectOperator(Operator):
 
     def add_input(self, page: DevicePage):
         assert self._pending is None
-        self._pending = self.processor.process(page)
+        self._pending = self.processor.process(page, self.params)
 
     def get_output(self) -> Optional[DevicePage]:
         out, self._pending = self._pending, None

@@ -95,10 +95,57 @@ class OutputBuffer:
         #: DeviceExchange.stats, so EXPLAIN ANALYZE reads identically
         #: whichever path a stage boundary took
         self._partition_rows = [0] * (1 if broadcast else num_partitions)
+        #: hot-partition lane split (round 16): partition -> lane count.
+        #: The host analog of the device collective's receiver spread —
+        #: a partition holding most of the exchange's rows saturates its
+        #: single pending-page bound and stalls EVERY producer however
+        #: much slack its siblings have.  Extra lanes multiply the hot
+        #: partition's capacity; enqueue round-robins rows-insensitive
+        #: pages across lanes and ``poll`` drains them transparently
+        #: (consumer-task co-location is untouched: all lanes ARE the
+        #: partition).  Only hash-kind producers may request a split —
+        #: merge-kind streams are per-producer SORTED and interleaving
+        #: lanes would break the consumer's merge invariant.
+        self._hot_lanes: Dict[int, int] = {}
+        self._lane_pages: Dict[tuple, List[Page]] = {}
+        self._lane_rows: Dict[tuple, int] = {}
+        self._enq_rr: Dict[int, int] = {}
+        self._drain_rr: Dict[int, int] = {}
         # streaming observability: did any consumer dequeue a page
         # before the producers finished?
         self.first_poll_ts: Optional[float] = None
         self.no_more_ts: Optional[float] = None
+
+    # -- hot-partition lanes ----------------------------------------------
+
+    def split_partition(self, partition: int, ways: int) -> bool:
+        """Grow ``partition`` to ``ways`` drain lanes (idempotent,
+        monotonic).  Returns whether the lane set changed.  Callers are
+        responsible for the kind gate: ONLY order-insensitive (hash)
+        producers may split."""
+        if self.broadcast or ways <= 1:
+            return False
+        with self._lock:
+            if self._aborted:
+                return False
+            cur = self._hot_lanes.get(partition, 1)
+            if cur >= ways:
+                return False
+            self._hot_lanes[partition] = ways
+            for lane in range(1, ways):
+                self._lane_pages.setdefault((partition, lane), [])
+                self._lane_rows.setdefault((partition, lane), 0)
+            fired = self._bump_locked()
+        for cb in fired:
+            cb()
+        return True
+
+    def _lane_pending_locked(self, partition: int, lane: int) -> int:
+        if lane == 0:
+            return len(self._pages[partition]) - self._cursors.get(
+                (partition, "drain"), 0)
+        return len(self._lane_pages[(partition, lane)]) - \
+            self._cursors.get((partition, "drain", lane), 0)
 
     # -- state/version plumbing -----------------------------------------
 
@@ -127,10 +174,21 @@ class OutputBuffer:
         with self._lock:
             if self._aborted:
                 return
-            self._pages[0 if self.broadcast else partition].append(page)
+            tgt = 0 if self.broadcast else partition
+            lanes = 1 if self.broadcast else self._hot_lanes.get(tgt, 1)
+            if lanes > 1:
+                k = self._enq_rr.get(tgt, 0)
+                self._enq_rr[tgt] = k + 1
+                lane = k % lanes
+            else:
+                lane = 0
+            if lane == 0:
+                self._pages[tgt].append(page)
+            else:
+                self._lane_pages[(tgt, lane)].append(page)
+                self._lane_rows[(tgt, lane)] += page.num_rows
             self._total_rows += page.num_rows
-            self._partition_rows[0 if self.broadcast
-                                 else partition] += page.num_rows
+            self._partition_rows[tgt] += page.num_rows
             fired = self._bump_locked()
         for cb in fired:
             cb()
@@ -155,6 +213,7 @@ class OutputBuffer:
             self._aborted = True
             self._no_more = True
             self._pages = [[] for _ in self._pages]
+            self._lane_pages = {k: [] for k in self._lane_pages}
             fired = self._bump_locked()
         for cb in fired:
             cb()
@@ -168,9 +227,12 @@ class OutputBuffer:
             idxs = range(len(self._pages)) if partitions is None \
                 else partitions
             for i in idxs:
-                pending = len(self._pages[i]) - self._cursors.get(
-                    (i, "drain"), 0)
-                if pending >= self.max_pending_pages:
+                # a split partition reports full only when EVERY lane
+                # is at the bound — the whole point of the extra lanes
+                lanes = self._hot_lanes.get(i, 1)
+                if all(self._lane_pending_locked(i, lane)
+                       >= self.max_pending_pages
+                       for lane in range(lanes)):
                     return True
         return False
 
@@ -189,16 +251,26 @@ class OutputBuffer:
                 else:
                     return None
             else:
-                cur = self._cursors.get((partition, "drain"), 0)
-                ps = self._pages[partition]
-                if cur < len(ps):
-                    self._cursors[(partition, "drain")] = cur + 1
-                    page = ps[cur]
-                    # single-consumer partition: release the slot so the
-                    # exchange doesn't pin the whole intermediate
-                    # dataset for the query's lifetime
-                    ps[cur] = None
-                else:
+                page = None
+                lanes = self._hot_lanes.get(partition, 1)
+                start = self._drain_rr.get(partition, 0)
+                for probe in range(lanes):
+                    lane = (start + probe) % lanes
+                    ps = self._pages[partition] if lane == 0 \
+                        else self._lane_pages[(partition, lane)]
+                    ckey = (partition, "drain") if lane == 0 \
+                        else (partition, "drain", lane)
+                    cur = self._cursors.get(ckey, 0)
+                    if cur < len(ps):
+                        self._cursors[ckey] = cur + 1
+                        page = ps[cur]
+                        # single-consumer partition: release the slot
+                        # so the exchange doesn't pin the whole
+                        # intermediate dataset for the query's lifetime
+                        ps[cur] = None
+                        self._drain_rr[partition] = lane + 1
+                        break
+                if page is None:
                     return None
             if self.first_poll_ts is None:
                 self.first_poll_ts = _time.monotonic()
@@ -207,6 +279,10 @@ class OutputBuffer:
             cb()
         return page
 
+    def _drained_locked(self, partition: int) -> bool:
+        return all(self._lane_pending_locked(partition, lane) <= 0
+                   for lane in range(self._hot_lanes.get(partition, 1)))
+
     def at_end(self, partition: int, consumer_id: int = 0) -> bool:
         with self._lock:
             if not self._no_more:
@@ -214,16 +290,14 @@ class OutputBuffer:
             if self.broadcast:
                 return self._cursors.get((0, consumer_id), 0) >= \
                     len(self._pages[0])
-            return self._cursors.get((partition, "drain"), 0) >= \
-                len(self._pages[partition])
+            return self._drained_locked(partition)
 
     def has_page(self, partition: int, consumer_id: int = 0) -> bool:
         with self._lock:
             if self.broadcast:
                 return self._cursors.get((0, consumer_id), 0) < \
                     len(self._pages[0])
-            return self._cursors.get((partition, "drain"), 0) < \
-                len(self._pages[partition])
+            return not self._drained_locked(partition)
 
     def channel(self, partition: int, consumer_id: int = 0):
         return ExchangeChannel(self, partition, consumer_id)
@@ -232,9 +306,13 @@ class OutputBuffer:
 
     def pages(self, partition: int) -> List[Page]:
         with self._lock:
-            return [p for p in
-                    self._pages[0 if self.broadcast else partition]
-                    if p is not None]
+            tgt = 0 if self.broadcast else partition
+            out = [p for p in self._pages[tgt] if p is not None]
+            if not self.broadcast:
+                for lane in range(1, self._hot_lanes.get(tgt, 1)):
+                    out.extend(p for p in self._lane_pages[(tgt, lane)]
+                               if p is not None)
+            return out
 
     @property
     def total_rows(self) -> int:
@@ -249,6 +327,7 @@ class OutputBuffer:
         ANALYZE renders stage boundaries identically on both paths."""
         with self._lock:
             rows = list(self._partition_rows)
+            hot = dict(self._hot_lanes)
         mean_rows = (sum(rows) / len(rows)) if rows else 0.0
         out = {
             "kind": "host",
@@ -261,6 +340,12 @@ class OutputBuffer:
             "partition_rows": rows,
             "skew_ratio": (round(max(rows) / mean_rows, 3)
                            if mean_rows > 0 else 0.0),
+            # device-path parity (DeviceExchange.stats): which
+            # partitions went hot and how wide their lanes spread
+            "hot_partitions": sorted(hot),
+            "splits": len(hot),
+            "split_ways": max(hot.values()) if hot else 1,
+            "hot_spread": hot,
         }
         if self.rebalancer is not None:
             out.update(self.rebalancer.stats())
@@ -320,13 +405,23 @@ class PartitionedOutputOperator(Operator):
     def __init__(self, input_types: Sequence[T.Type],
                  key_channels: Sequence[int], buffer: OutputBuffer,
                  kind: str = "hash", task_partition: int = 0,
-                 rebalancer=None):
+                 rebalancer=None, hot_split_threshold: float = 0.5):
         assert kind in ("hash", "single", "broadcast", "merge")
         self.input_types = list(input_types)
         self.key_channels = list(key_channels)
         self.buffer = buffer
         self.kind = kind
         self.task_partition = task_partition
+        #: host analog of the device collective's hot-partition split
+        #: (round 16): when one partition's observed share of this
+        #: producer's rows exceeds the threshold on a BOUNDED buffer,
+        #: the partition grows extra drain lanes so its pending-page
+        #: bound scales like the device path's receiver spread.  Hash
+        #: kind ONLY — merge streams are sorted and must not interleave
+        #: — and never under a rebalancer (scaled writers already
+        #: spread hot partitions across lanes).
+        self.hot_split_threshold = float(hot_split_threshold)
+        self._observed_rows: Optional[np.ndarray] = None
         #: scaled-writer boundary: a UniformPartitionRebalancer mapping
         #: MORE logical hash partitions than writer lanes; hot logical
         #: partitions are scaled across several lanes (rows round-robin
@@ -383,6 +478,9 @@ class PartitionedOutputOperator(Operator):
         valid = np.asarray(page.valid)
         if self.rebalancer is not None:
             part = self._rebalanced_lanes(part, valid)
+        elif self.hot_split_threshold < 1.0 and n > 1 and \
+                self.buffer.max_pending_pages is not None:
+            self._split_hot(np.bincount(part[valid], minlength=n)[:n])
         cols = [np.asarray(c) for c in page.cols]
         nulls = [np.asarray(x) for x in page.nulls]
         for p in range(n):
@@ -395,6 +493,23 @@ class PartitionedOutputOperator(Operator):
                 bn = nl[idx]
                 blocks.append(Block(t, c[idx], bn if bn.any() else None, d))
             self.buffer.enqueue(p, Page(blocks, len(idx)))
+
+    def _split_hot(self, page_rows: np.ndarray):
+        """Accumulate this producer's per-partition row histogram and
+        grow lanes for any partition above the hot threshold — the same
+        observed-share trigger as DeviceExchange's count-pass split,
+        applied to the host buffer's capacity bounds."""
+        if self._observed_rows is None:
+            self._observed_rows = page_rows.astype(np.int64)
+        else:
+            self._observed_rows += page_rows
+        total = int(self._observed_rows.sum())
+        if total == 0:
+            return
+        ways = max(2, self.buffer.num_partitions)
+        for p in np.nonzero(self._observed_rows / total
+                            > self.hot_split_threshold)[0]:
+            self.buffer.split_partition(int(p), ways)
 
     def _rebalanced_lanes(self, part: np.ndarray,
                           valid: np.ndarray) -> np.ndarray:

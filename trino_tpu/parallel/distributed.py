@@ -454,7 +454,9 @@ class DistributedQueryRunner:
                 ops.append(PartitionedOutputOperator(
                     types_, key_channels, out, frag.output_kind,
                     task_partition=t,
-                    rebalancer=getattr(out, "rebalancer", None)))
+                    rebalancer=getattr(out, "rebalancer", None),
+                    hot_split_threshold=SP.value(
+                        self.session, "hot_partition_split_threshold")))
             planner.pipelines.append(PhysicalPipeline(ops))
             pipelines = planner.pipelines
         for p in pipelines:

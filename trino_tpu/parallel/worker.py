@@ -778,9 +778,13 @@ class WorkerServer:
                 SP.prop_value(session_props,
                               "rebalance_min_collectives"))
             buffer.rebalancer = rebalancer  # stage-level stats surface
-        ops.append(PartitionedOutputOperator(types_, key_channels, buffer,
-                                             frag.output_kind,
-                                             rebalancer=rebalancer))
+        from .. import session_properties as SP
+
+        ops.append(PartitionedOutputOperator(
+            types_, key_channels, buffer, frag.output_kind,
+            rebalancer=rebalancer,
+            hot_split_threshold=SP.prop_value(
+                session_props, "hot_partition_split_threshold")))
         planner.pipelines.append(PhysicalPipeline(ops))
         # the exec span is the driver-run wall: its operator children's
         # busy time must account for ~all of it (the trace-tree test's

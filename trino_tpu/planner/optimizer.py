@@ -60,7 +60,58 @@ def optimize(root: OutputNode, metadata: Metadata,
     # the final plan nodes the local planner and EXPLAIN read
     out.optimizer_trace += annotate_kernel_strategies(node, metadata,
                                                       session, hbo=hbo)
+    slots = template_param_slots(out)
+    if slots:
+        out.optimizer_trace.append((
+            "PlanTemplate",
+            "%d opaque parameter slot%s; folding/pushdown value-blind"
+            % (len(slots), "" if len(slots) == 1 else "s")))
     return out
+
+
+def template_param_slots(root: PlanNode) -> Tuple[int, ...]:
+    """The sorted ``ParamRef`` slot indices reachable from any
+    expression of the plan (empty for non-template plans).  The
+    optimizer itself never needs this — ParamRef is opaque to every
+    value-reading pass BY CONSTRUCTION (it is not a Literal subclass,
+    and folding/pushdown/domain translation are all
+    ``isinstance(_, Literal)``-gated) — but the runner's batch
+    assembler and EXPLAIN both want to know which slots survived into
+    the optimized plan, and a slot that was optimized AWAY (pruned
+    with its projection) is exactly the "params_unconsumed" batching
+    fallback."""
+    from ..expr.ir import param_indices
+
+    slots: Set[int] = set()
+    seen: Set[int] = set()
+    plan_mod = PlanNode.__module__
+
+    def walk_value(v):
+        if isinstance(v, RowExpression):
+            slots.update(param_indices(v))
+        elif isinstance(v, dict):
+            for x in v.values():
+                walk_value(x)
+        elif isinstance(v, (list, tuple)):
+            for x in v:
+                walk_value(x)
+        elif isinstance(v, PlanNode):
+            walk_node(v)
+        elif type(v).__module__ == plan_mod and hasattr(v, "__dict__"):
+            # expression-bearing leaf specs (Aggregation, Ordering,
+            # WindowFunctionSpec, ...) — same module, not PlanNodes
+            for x in vars(v).values():
+                walk_value(x)
+
+    def walk_node(node):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for v in vars(node).values():
+            walk_value(v)
+
+    walk_node(root)
+    return tuple(sorted(slots))
 
 
 def provenance_lines(root: OutputNode) -> List[str]:

@@ -10,7 +10,7 @@ with exchanges between fragments (parallel/ package).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import types as T
 from .block import Page
@@ -221,61 +221,369 @@ class LocalQueryRunner:
             return None
         return max(int(2 * hint["peak_bytes"]), 64 << 20)
 
+    # -- plan templates (round 16) -------------------------------------
+
+    @staticmethod
+    def _template_ineligible_reason(shape) -> Optional[str]:
+        """Pre-walk guard for the SILENT value-dependence hazard: a
+        GROUP BY 1 / ORDER BY 1 ordinal is a LongLiteral the shape
+        turned into a Parameter, and the logical planner's
+        ``isinstance(e, ast.LongLiteral)`` ordinal checks would quietly
+        plan group-by-constant instead of group-by-column.  (Sites that
+        REQUIRE a literal value — window offsets, VALUES rows, string
+        IN lists — raise catchably during the template's trial plan
+        instead, so only the silent sites need a walk.)"""
+        from .cache import _walk_nodes
+
+        for node in _walk_nodes(shape):
+            if isinstance(node, ast.GroupBy):
+                exprs = list(node.expressions) + \
+                    [e for s in node.sets for e in s]
+                if any(isinstance(e, ast.Parameter) for e in exprs):
+                    return "ordinal_param"
+            elif isinstance(node, ast.SortItem):
+                if isinstance(node.key, ast.Parameter):
+                    return "ordinal_param"
+        return None
+
+    def _plan_template(self, pq, user: str, hbo_ctx=None,
+                       uses: int = 1):
+        """The shape's ``cache.PlanTemplate`` — built, cached, or None
+        (disabled / not yet earned / fallback).  A template plans the
+        normalized shape directly: ``ast.Parameter`` markers lower to
+        opaque ``ParamRef`` IR via the analyzer's template-parameter
+        context, so optimizer constant folding and pushdown cannot
+        specialize on a literal value.  A trial local plan runs at
+        build time so every compiled-path value dependence (string
+        params, LIKE patterns, VALUES rows, window offsets) fails HERE
+        — loudly, negative-cached by reason — never at member
+        execution."""
+        from . import session_properties as SP
+
+        if not SP.value(self.session, "plan_template_enabled"):
+            return None
+        if not pq.is_query or not pq.literals:
+            return None
+        tkey = self.query_cache.template_key(pq, self.session, user=user)
+        if tkey is None:
+            return None
+        tc = self.query_cache.templates
+        total_uses = tc.note_uses(pq.shape, uses)
+        hit = tc.lookup(tkey)
+        if hit is not None:
+            kind, val = hit
+            return val if kind == "hit" else None
+        hint = None
+        if hbo_ctx is not None:
+            try:
+                hint = hbo_ctx.statement_hint()
+            except Exception:
+                hint = None
+        if total_uses < SP.value(self.session,
+                                 "batched_execution_min_shape_uses") \
+                and not hint:
+            return None  # not yet earned: the build trial must amortize
+        max_entries = SP.value(self.session, "plan_cache_entries")
+        reason = self._template_ineligible_reason(pq.shape)
+        if reason is not None:
+            tc.store_fallback(tkey, reason, max_entries)
+            return None
+        from .cache import PlanTemplate, analyze_literal_tokens
+        from .expr.compiler import param_raw
+        from .sql.analyzer import template_parameters
+
+        try:
+            lits = analyze_literal_tokens(pq.literals, self.session)
+            ptypes = tuple(lit.type for lit in lits)
+            if any(getattr(t, "is_pooled", False) for t in ptypes):
+                tc.store_fallback(tkey, "string_param", max_entries)
+                return None
+            with template_parameters(ptypes):
+                root = self.plan_statement(pq.shape, hbo=hbo_ctx)
+                # trial local plan (head literals bound): processor
+                # construction is where remaining literal-value
+                # dependence surfaces, catchably
+                trial = self._make_local_planner(
+                    processor_cache=self.query_cache.processors,
+                    params={i: param_raw(t, lit.value)
+                            for i, (t, lit)
+                            in enumerate(zip(ptypes, lits))})
+                try:
+                    trial.plan(root)
+                finally:
+                    trial.memory_pool.close()
+        except T.TrinoError:
+            # AnalysisError / TypeError_ / NOT_SUPPORTED — planning or
+            # compilation genuinely needs a literal value
+            tc.store_fallback(tkey, "value_dependent", max_entries)
+            return None
+        template = PlanTemplate(root, ptypes,
+                                scan_refs=self._scan_refs(root))
+        tc.store(tkey, template, max_entries)
+        return template
+
+    def _template_binding(self, template, pq) -> Optional[Tuple]:
+        """This member's literal values per ParamRef slot under
+        ``template``, or None when its analyzed literal types drift
+        from the template's (varchar lengths, decimal scales — a
+        different-typed plan)."""
+        from .cache import analyze_literal_tokens
+
+        try:
+            lits = analyze_literal_tokens(pq.literals, self.session)
+        except T.TrinoError:
+            return None
+        if tuple(lit.type for lit in lits) != template.param_types:
+            return None
+        return tuple(lit.value for lit in lits)
+
+    # -- admission batching --------------------------------------------
+
     def execute_batch(self, sqls: Sequence[str],
                       user: Optional[str] = None) -> List:
         """Admission batching: ONE resource-group slot covers a burst of
         (typically same-shape) statements — the dispatcher-side
-        amortization for high-QPS tenants.  Identical texts coalesce to
-        a single execution whose result demuxes to every submitter;
-        distinct texts execute serially inside the slot through the
-        plan/processor caches, so results are byte-equal to the serial
-        path by construction.  Returns one QueryResult OR Exception per
-        statement, positionally — a failure fails only its own
-        statement, not the batch."""
+        amortization for high-QPS tenants.  Same-shape deterministic
+        members ride the plan template's VMAPPED path: their literal
+        vectors stack on a (B,) axis and every pipeline stage runs as
+        one device launch, demuxed positionally (result-cache hits
+        short-circuit without occupying a lane; ACL is enforced per
+        member).  Identical texts coalesce to a single execution whose
+        result demuxes to every submitter; everything else executes
+        serially inside the slot through the plan/processor caches, so
+        results are byte-equal to the serial path by construction.
+        Returns one QueryResult OR Exception per statement,
+        positionally — a failure fails only its own statement, not the
+        batch."""
         user = user or self.session.user
         self.access_control.check_can_execute_query(user)
-
-        def coalescable(sql: str) -> bool:
-            # only deterministic plain queries may demux one execution
-            # to several submitters: repeat INSERTs must run per
-            # statement, and random()-class calls must diverge exactly
-            # as they would serially
-            try:
-                pq = self.query_cache.parse(sql, self.session)
-            except Exception:
-                return False
-            return pq.is_query and pq.deterministic
-
-        def run_all() -> List:
-            out: List = []
-            memo: Dict[str, object] = {}
-            coalesced = 0
-            for sql in sqls:
-                if sql in memo:
-                    coalesced += 1
-                    out.append(memo[sql])
-                    continue
-                try:
-                    res = self._monitored_execute(sql, user)
-                except Exception as e:  # demuxed per statement
-                    out.append(e)
-                    if coalescable(sql):
-                        memo[sql] = e
-                else:
-                    out.append(res)
-                    if coalescable(sql):
-                        memo[sql] = res
-            self.query_cache.note_batch(len(out), coalesced)
-            return out
-
         if self.resource_groups is not None:
             from . import session_properties as SP
 
             group = self.resource_groups.select(user)
             with group.run(memory_bytes=SP.value(
                     self.session, "query_max_memory_bytes")):
-                return run_all()
-        return run_all()
+                return self._run_batch(sqls, user)
+        return self._run_batch(sqls, user)
+
+    def _coalescable(self, sql: str) -> bool:
+        # only deterministic plain queries may demux one execution to
+        # several submitters: repeat INSERTs must run per statement,
+        # and random()-class calls must diverge exactly as they would
+        # serially
+        try:
+            pq = self.query_cache.parse(sql, self.session)
+        except Exception:
+            return False
+        return pq.is_query and pq.deterministic
+
+    def _run_batch(self, sqls: Sequence[str], user: str) -> List:
+        from . import session_properties as SP
+
+        out: List = [None] * len(sqls)
+        done = [False] * len(sqls)
+        coalesced = 0
+        if SP.value(self.session, "batched_execution_enabled"):
+            # group batchable members by shape (the protocol drains
+            # same-shape bursts, but direct callers may mix)
+            groups: Dict[object, List[int]] = {}
+            for i, sql in enumerate(sqls):
+                try:
+                    pq = self.query_cache.parse(sql, self.session)
+                except Exception:
+                    continue  # fails identically on the serial path
+                if pq.is_query and pq.deterministic and pq.literals:
+                    groups.setdefault(pq.shape, []).append(i)
+            for idxs in groups.values():
+                if len(idxs) < 2:
+                    continue  # nothing to amortize into one launch
+                served = self._try_batched(
+                    [(i, sqls[i]) for i in idxs], user)
+                for i, res in served.items():
+                    out[i] = res
+                    done[i] = True
+        memo: Dict[str, object] = {}
+        for i, sql in enumerate(sqls):
+            if done[i]:
+                continue
+            if sql in memo:
+                coalesced += 1
+                out[i] = memo[sql]
+                continue
+            try:
+                res = self._monitored_execute(sql, user)
+            except Exception as e:  # demuxed per statement
+                out[i] = e
+                if self._coalescable(sql):
+                    memo[sql] = e
+            else:
+                out[i] = res
+                if self._coalescable(sql):
+                    memo[sql] = res
+        self.query_cache.note_batch(len(out), coalesced)
+        return out
+
+    def _try_batched(self, members: List[tuple], user: str) -> Dict:
+        """Attempt the single-launch path for one same-shape group.
+        Returns {position: QueryResult|Exception} for every member this
+        path fully handled (vmapped lanes, result-cache
+        short-circuits, per-member ACL failures, coalesced duplicates);
+        members NOT in the dict fall back to the serial loop — which
+        still rides the shared template serially (zero retraces, N
+        launches), so the fallback is slower, never different."""
+        from . import session_properties as SP
+        from .block import padded_size
+        from .exec.batched import BatchIneligible, execute_batched
+
+        served: Dict[int, object] = {}
+        pqs = {i: self.query_cache.parse(sql, self.session)
+               for i, sql in members}
+        pq0 = pqs[members[0][0]]
+        try:
+            hbo_ctx = self._hbo_context(pq0.stmt)
+        except Exception:
+            hbo_ctx = None
+        template = self._plan_template(pq0, user, hbo_ctx,
+                                       uses=len(members))
+        if template is None:
+            return served
+        tc = self.query_cache.templates
+        result_caching = SP.value(self.session, "result_cache_enabled")
+        # per-member admission: ACL, result-cache short-circuit,
+        # identical-literal-vector coalescing into one lane
+        lanes: List[tuple] = []       # (literals, [positions], key)
+        lane_of: Dict[tuple, int] = {}
+        for pos, sql in members:
+            pq = pqs[pos]
+            try:
+                # per-tenant ACL per statement, exactly as serial
+                self._check_table_access(pq.stmt, template.root, user)
+            except Exception as e:
+                served[pos] = e
+                continue
+            key = self.query_cache.cache_key(pq, self.session, user=user)
+            if result_caching and key is not None:
+                hit = self.query_cache.results.lookup(key)
+                if hit is not None:
+                    # full-key hit: serve WITHOUT occupying a vmap lane
+                    names, types_, rows, _nb, scans = hit
+                    try:
+                        for catalog, schema, table, cols in scans:
+                            self.access_control.check_can_select(
+                                user, catalog, schema, table, cols)
+                    except Exception as e:
+                        served[pos] = e
+                        continue
+                    served[pos] = QueryResult(
+                        list(names), list(types_), list(rows),
+                        stats={"result_cache": "hit"})
+                    with self.query_cache._lock:
+                        self.query_cache.result_shortcircuits += 1
+                    continue
+            if pq.literals in lane_of:
+                lanes[lane_of[pq.literals]][1].append(pos)
+            else:
+                lane_of[pq.literals] = len(lanes)
+                lanes.append((pq.literals, [pos], key))
+        if not lanes:
+            return served
+        # bind each lane's literal vector; type drift falls back
+        bound: List[tuple] = []       # (values, positions, key)
+        for _lits, positions, key in lanes:
+            values = self._template_binding(template, pqs[positions[0]])
+            if values is None:
+                tc.note_fallback("param_type_drift")
+                continue
+            bound.append((values, positions, key))
+        if not bound:
+            return served
+        max_depth = SP.value(self.session, "batched_execution_max_depth")
+        pad_limit = SP.value(self.session,
+                             "batched_execution_pad_rows_limit")
+        hint = None
+        if hbo_ctx is not None:
+            try:
+                hint = hbo_ctx.statement_hint()
+            except Exception:
+                hint = None
+        pad_exact = bool(hint and
+                         hint.get("scan_rows", 0) >= pad_limit)
+        from .expr.compiler import param_raw
+
+        for start in range(0, len(bound), max_depth):
+            chunk = bound[start:start + max_depth]
+            B = len(chunk)
+            depth = B if pad_exact else padded_size(B, minimum=1)
+            padded = [values for values, _, _ in chunk] + \
+                [chunk[-1][0]] * (depth - B)
+            # operator construction binds the first lane's values (the
+            # serial-fallback contract); execute_batched drives the
+            # processors with the STACKED vectors instead
+            local = self._make_local_planner(
+                processor_cache=self.query_cache.processors,
+                params={i: param_raw(t, chunk[0][0][i])
+                        for i, t in enumerate(template.param_types)})
+            try:
+                try:
+                    plan = local.plan(template.root)
+                    pages_per = execute_batched(
+                        plan, template.param_types, padded, B)
+                except BatchIneligible as e:
+                    tc.note_fallback(e.reason)
+                    return served  # remaining members run serially
+                except Exception as e:
+                    # execution error: every lane would hit it serially
+                    for _, positions, _ in chunk:
+                        for pos in positions:
+                            served[pos] = e
+                            self._batch_member_event(
+                                members, pos, user, error=e)
+                    continue
+            finally:
+                local.memory_pool.close()
+            with self.query_cache._lock:
+                self.query_cache.batched_launches += B
+            for lane_i, (values, positions, key) in enumerate(chunk):
+                rows: List[tuple] = []
+                for p in pages_per[lane_i]:
+                    rows.extend(p.to_rows())
+                res = QueryResult(
+                    plan.column_names, plan.output_types, rows,
+                    stats={"plan_template": "hit",
+                           "batched_depth": depth})
+                if result_caching and key is not None and \
+                        self.query_cache.cache_key(
+                            pqs[positions[0]], self.session,
+                            user=user) == key:
+                    self.query_cache.results.store(
+                        key, res.column_names, res.types, list(rows),
+                        scans=template.scan_refs)
+                for extra, pos in enumerate(positions):
+                    served[pos] = res
+                    self._batch_member_event(members, pos, user,
+                                             rows=len(rows))
+                    if extra:
+                        coalesced_here = 1  # identical literal vector
+                        with self.query_cache._lock:
+                            self.query_cache.coalesced += coalesced_here
+        return served
+
+    def _batch_member_event(self, members, pos, user, rows=0,
+                            error=None):
+        """Query lifecycle events for a vmapped batch member — the
+        serial path fires these through _monitored_execute, and
+        system.runtime.queries must see batched statements too."""
+        if not self.event_manager.listeners:
+            return
+        from .events import QueryMonitor
+
+        sql = dict(members)[pos]
+        monitor = QueryMonitor(self.event_manager, user, sql)
+        monitor.created()
+        if error is not None:
+            monitor.failed(error)
+        else:
+            monitor.completed(rows)
 
     def _monitored_execute(self, sql: str, user: str,
                            progress=None) -> QueryResult:
@@ -457,6 +765,27 @@ class LocalQueryRunner:
         root = self.query_cache.plans.lookup(key) \
             if key is not None else None
         plan_hit = root is not None
+        template_params: Optional[Dict] = None
+        if root is None and key is not None:
+            # a shape template serves EVERY literal vector of this
+            # shape: one optimized root, literal values bound as
+            # ParamRef inputs at execution (the same programs the
+            # vmapped batch path traces, so serial statements keep
+            # them warm).  Template roots are never stored in the
+            # plan cache — plan-cache executions pass no params.
+            template = self._plan_template(pq, user, hbo_ctx)
+            if template is not None:
+                values = self._template_binding(template, pq)
+                if values is None:
+                    self.query_cache.templates.note_fallback(
+                        "param_type_drift")
+                else:
+                    from .expr.compiler import param_raw
+
+                    template_params = {
+                        i: param_raw(t, v) for i, (t, v) in
+                        enumerate(zip(template.param_types, values))}
+                    root = template.root
         if root is None:
             root = self.plan_statement(stmt, hbo=hbo_ctx)
             if key is not None:
@@ -479,7 +808,7 @@ class LocalQueryRunner:
         local = self._make_local_planner(
             processor_cache=self.query_cache.processors
             if plan_caching else None, progress=progress,
-            hbo=hbo_ctx)
+            hbo=hbo_ctx, params=template_params)
         from .telemetry.profiler import profiling
 
         with profiling(SP.value(self.session,
@@ -512,6 +841,8 @@ class LocalQueryRunner:
                                         for df in local.dynamic_filters]
         if plan_hit:
             stats["plan_cache"] = "hit"
+        if template_params is not None:
+            stats["plan_template"] = "hit"
         res = QueryResult(plan.column_names, plan.output_types, rows,
                           stats=stats)
         if result_caching:
@@ -539,10 +870,11 @@ class LocalQueryRunner:
 
     def _make_local_planner(self, processor_cache=None,
                             progress=None,
-                            hbo=None) -> LocalExecutionPlanner:
+                            hbo=None, params=None) -> LocalExecutionPlanner:
         """Session-configured planner: ALL execution paths (execute,
         EXPLAIN ANALYZE, the DELETE rewrite) must honor the same
-        session knobs."""
+        session knobs.  ``params`` binds a plan template's ParamRef
+        slots (global literal index -> raw scalar) for one statement."""
         from . import session_properties as SP
         from .exec.memory import pool_from_session
 
@@ -554,7 +886,8 @@ class LocalQueryRunner:
                                        "enable_dynamic_filtering"),
             scan_coalesce=SP.value(self.session, "scan_coalesce_enabled"),
             processor_cache=processor_cache, progress=progress,
-            hbo=hbo, **grouping_options(self.session.properties))
+            hbo=hbo, params=params,
+            **grouping_options(self.session.properties))
 
     def _scan_rows_estimate(self, root: OutputNode) -> int:
         """Connector-statistics row estimate summed over the plan's

@@ -62,6 +62,11 @@ plan_cache_enabled, plan_cache_entries,    runner.py
 result_cache_enabled
 admission_batching_enabled,                server/protocol.py
 admission_batch_max
+plan_template_enabled,                     runner.py
+batched_execution_enabled,
+batched_execution_max_depth,
+batched_execution_min_shape_uses,
+batched_execution_pad_rows_limit
 query_profiling_enabled                    runner.py,
                                            parallel/distributed.py,
                                            parallel/worker.py
@@ -398,6 +403,44 @@ register(SessionProperty(
     "admission_batch_max", "integer", 16,
     "Largest statement burst one admission slot may absorb",
     lambda v: v >= 2))
+register(SessionProperty(
+    "plan_template_enabled", "boolean", True,
+    "Value-independent plan templates (round 16): plan a statement "
+    "SHAPE once with its cache-marked literals as opaque ParamRef "
+    "slots, then serve every literal vector of the shape from that one "
+    "optimized plan and the one set of compiled (param-slotted) "
+    "PageProcessors — a new-literal repeat statement performs zero "
+    "planning and zero jit traces. Shapes whose planning genuinely "
+    "depends on a literal value fall back to per-statement planning, "
+    "loudly counted by reason (trino_plan_template_total)"))
+register(SessionProperty(
+    "batched_execution_enabled", "boolean", True,
+    "Single-launch batched execution: a same-shape admission burst "
+    "stacks its literal vectors on a (B,) axis and runs each "
+    "scan->filter/project pipeline stage as ONE vmapped device launch "
+    "(per-statement demux of result pages; ACL and result-cache "
+    "semantics enforced per member exactly as the serial path). "
+    "Requires plan_template_enabled; ineligible plans execute serially "
+    "through the shared template, byte-equal by construction"))
+register(SessionProperty(
+    "batched_execution_max_depth", "integer", 16,
+    "Deepest (B,) literal-batch axis one vmapped launch may carry; "
+    "larger bursts execute in chunks of this depth",
+    lambda v: v >= 2))
+register(SessionProperty(
+    "batched_execution_min_shape_uses", "integer", 2,
+    "Submissions of a statement shape (a batch of B counts as B) "
+    "before it earns a plan template — the build trial must amortize; "
+    "shapes with recorded history (HBO statement hint) qualify "
+    "immediately",
+    lambda v: v >= 1))
+register(SessionProperty(
+    "batched_execution_pad_rows_limit", "integer", 1_000_000,
+    "HBO-informed padding policy: when the shape's recorded scan rows "
+    "reach this limit, batch depth pads to the exact member count "
+    "instead of the next power of two (padding lanes re-scan the "
+    "whole input — FLOPs that stop paying once pages are large)",
+    lambda v: v >= 1))
 register(SessionProperty(
     "query_profiling_enabled", "boolean", False,
     "Compiled-program profiling (telemetry.profiler): record trace/"

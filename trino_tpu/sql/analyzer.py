@@ -15,13 +15,15 @@ from __future__ import annotations
 
 import datetime as _dt
 import re
+import threading
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .. import types as T
 from ..expr import functions as F
 from ..expr.functions import days_from_civil_host
-from ..expr.ir import Call, Literal, RowExpression
+from ..expr.ir import Call, Literal, ParamRef, RowExpression
 from ..planner.symbols import Symbol, SymbolRef
 from ..types import TrinoError
 from . import ast
@@ -30,6 +32,27 @@ from . import ast
 class AnalysisError(TrinoError):
     def __init__(self, message: str):
         super().__init__(message, code="ANALYSIS_ERROR")
+
+
+#: template-planning parameter context (round 16): when a normalized
+#: statement shape is planned DIRECTLY (its cache-marked literals left
+#: as ``ast.Parameter`` markers), this thread-local carries the IR type
+#: of each parameter slot so ``_an_Parameter`` can lower the marker to
+#: an opaque ``ParamRef`` instead of a baked constant.  Outside the
+#: context a Parameter is an analysis error — ordinary statements never
+#: contain markers.
+_TEMPLATE_PARAMS = threading.local()
+
+
+@contextmanager
+def template_parameters(types_: Tuple[T.Type, ...]):
+    """Plan with ``ast.Parameter(i)`` lowering to ``ParamRef(types_[i], i)``."""
+    prev = getattr(_TEMPLATE_PARAMS, "types", None)
+    _TEMPLATE_PARAMS.types = tuple(types_)
+    try:
+        yield
+    finally:
+        _TEMPLATE_PARAMS.types = prev
 
 
 # aggregate function names (reference: metadata/SystemFunctionBundle
@@ -234,6 +257,14 @@ class ExpressionAnalyzer:
 
     def _an_LongLiteral(self, e):
         return Literal(T.BIGINT, e.value)
+
+    def _an_Parameter(self, e):
+        # cache-marked literal slot of a normalized shape: opaque to
+        # every plan-time constant reader (template planning, round 16)
+        types_ = getattr(_TEMPLATE_PARAMS, "types", None)
+        if types_ is None or e.position >= len(types_):
+            raise AnalysisError("parameter outside template planning")
+        return ParamRef(types_[e.position], e.position)
 
     def _an_DoubleLiteral(self, e):
         return Literal(T.DOUBLE, e.value)
