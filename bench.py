@@ -956,7 +956,12 @@ def _qps_smoke():
     ``batch_launch_depth:<schema>`` ratchet: profiler-counted device
     launches per statement for an 8-statement same-shape burst through
     ``execute_batch`` — the single-launch vmapped path must keep this
-    under 1.0, and the committed baseline may only shrink."""
+    under 1.0, and the committed baseline may only shrink.  Round 17
+    adds ``batch_launch_depth_agg:<schema>`` with the same strict
+    rules for an aggregating (GROUP BY) 8-burst riding the masked
+    vmapped agg barrier, which must actually engage
+    (``agg_stage_vmapped`` > 0 — serial fallback would fail the run
+    even below 1.0)."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                           "/tmp/trino_tpu_jax_cache")
@@ -1082,9 +1087,9 @@ def _qps_smoke():
     # the batch depth B, so launches-per-statement is the ratchetable
     # amortization metric (serial execution pays >= 1.0; a 2-stage
     # fully batched pipeline over one scan page pays 2/8 = 0.25)
-    # the witness shape is filter/project (scan->fp*->collect): that is
-    # the vmappable pipeline class; the aggregating tiny_templates fall
-    # back to serial template riding by design (non_fp_stage)
+    # the witness shape is filter/project (scan->fp*->collect): the
+    # original (round 16) vmappable pipeline class; aggregating shapes
+    # get their OWN witness + ratchet below (round 17)
     from trino_tpu.telemetry import profiler as _prof
     burst_tpl = ("select o_orderkey, o_totalprice from orders "
                  "where o_custkey % 64 = {t}")
@@ -1102,6 +1107,30 @@ def _qps_smoke():
                    if e["name"] in ("page_processor",
                                     "page_processor_batched"))
     launch_depth = round(launches / len(burst), 4)
+
+    # aggregating single-launch witness (round 17): a GROUP BY burst
+    # rides the masked vmapped agg barrier — per-page partial kernels
+    # plus one merge/finalize barrier for the whole batch, so its
+    # launch depth ratchets separately (more stages than the fp-only
+    # shape, still well under the serial 1.0/statement)
+    agg_tpl = ("select o_orderpriority, count(*) c, "
+               "sum(o_totalprice) s from orders "
+               "where o_custkey % 64 = {t} group by o_orderpriority")
+    agg_burst = [agg_tpl.format(t=t) for t in range(8)]
+    runner.execute_batch(agg_burst, user="tenant-0")  # warm traces
+    agg_burst2 = [agg_tpl.format(t=t) for t in range(8, 16)]
+    _prof.reset()
+    with _prof.profiling(True):
+        runner.execute_batch(agg_burst2, user="tenant-0")
+        _asnap = _prof.snapshot()
+    agg_launches = sum(
+        e["calls"] for e in _asnap
+        if e["name"] in ("page_processor", "page_processor_batched",
+                         "batched_agg_partial", "batched_agg_merge",
+                         "batched_agg_finalize"))
+    agg_launch_depth = round(agg_launches / len(agg_burst2), 4)
+    agg_vmapped = runner.query_cache.templates.dispositions.get(
+        "agg_stage_vmapped", 0)
     batched_launches = runner.query_cache.batched_launches
     counters = runner.query_cache.counters()
 
@@ -1127,6 +1156,9 @@ def _qps_smoke():
     # launches-per-statement means the vmapped path stopped amortizing
     depth_base = cache.get(f"batch_launch_depth:{schema}")
     depth_regressed = bool(depth_base) and launch_depth > depth_base
+    agg_depth_base = cache.get(f"batch_launch_depth_agg:{schema}")
+    agg_depth_regressed = bool(agg_depth_base) \
+        and agg_launch_depth > agg_depth_base
     # template-eligible shapes ride the plan TEMPLATE (round 16), whose
     # roots deliberately never enter the value-specialized plan cache —
     # the "planning amortized" witness is the SUM of both reuse paths
@@ -1140,8 +1172,11 @@ def _qps_smoke():
           and speedup >= min_speedup
           and batched_launches > 0
           and launch_depth < 1.0
+          and agg_launch_depth < 1.0
+          and agg_vmapped > 0
           and not regressed
-          and not depth_regressed)
+          and not depth_regressed
+          and not agg_depth_regressed)
     out = {
         "ok": ok, "schema": schema, "clients": n_clients,
         "uncached": off, "cached": on, "speedup": speedup,
@@ -1155,6 +1190,8 @@ def _qps_smoke():
         "templates": {k: v for k, v in counters.items()
                       if k.startswith("template")},
         "batch_launch_depth": launch_depth,
+        "batch_launch_depth_agg": agg_launch_depth,
+        "agg_stage_vmapped": agg_vmapped,
         "probe_traces": probe_traces,
         "query_states_left": states_left,
         "wall_s": round(time.time() - t_start, 2),
@@ -1176,6 +1213,13 @@ def _qps_smoke():
         "vs_baseline": (round(launch_depth / depth_base, 3)
                         if depth_base else 0.0),
         "batched_launches": batched_launches,
+    }), flush=True)
+    print(json.dumps({
+        "metric": f"qps_{schema}_batch_launch_depth_agg",
+        "value": agg_launch_depth, "unit": "launches_per_statement",
+        "vs_baseline": (round(agg_launch_depth / agg_depth_base, 3)
+                        if agg_depth_base else 0.0),
+        "agg_stage_vmapped": agg_vmapped,
     }), flush=True)
     if regressed:
         print(json.dumps({

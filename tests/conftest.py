@@ -38,3 +38,18 @@ jax.config.update("jax_compilation_cache_dir", "/tmp/trino_tpu_jax_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_template_seeds():
+    """The round-17 template-seed store is process-global (like the HBO
+    stats store); without clearing it between tests, one test's earned
+    shapes let a LATER test's fresh runner ride a template on its first
+    use — admission-timing assertions then depend on test order."""
+    yield
+    from trino_tpu.cache import template_seeds
+
+    template_seeds().clear()

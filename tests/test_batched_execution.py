@@ -257,14 +257,29 @@ def test_fallback_value_dependent(runner):
 
 
 def test_fallback_plan_shape_not_vmappable(runner):
-    """A same-shape group whose local plan is richer than
-    scan->fp*->collect (aggregation) still answers correctly — through
-    the serial path — and counts its reason."""
+    """A stage the masked pipeline genuinely cannot vmap (ORDER BY's
+    sort) still answers correctly — through the serial path — and
+    counts the round-17 taxonomy reason, not a catch-all."""
+    sqls = ["select v from t where k > %d order by v" % i for i in (5, 6)]
+    out = runner.execute_batch(sqls)
+    assert [o.rows for o in out] == [[(60,), (70,), (80,)],
+                                     [(70,), (80,)]]
+    fb = runner.query_cache.templates.fallbacks
+    assert fb.get("unsupported_stage") == 1, fb
+    assert runner.query_cache.batched_launches == 0
+
+
+def test_global_aggregation_now_vmaps(runner):
+    """The round-16 fallback case — count(*) over a filtered scan — is
+    a masked vmapped lane as of round 17: no fallback, one batched
+    launch per member, byte-equal demux."""
     sqls = ["select count(*) from t where k > %d" % i for i in (1, 2)]
     out = runner.execute_batch(sqls)
     assert [o.rows for o in out] == [[(7,)], [(6,)]]
-    fb = runner.query_cache.templates.fallbacks
-    assert sum(fb.values()) > 0, fb
+    assert not runner.query_cache.templates.fallbacks
+    assert runner.query_cache.templates.dispositions.get(
+        "agg_stage_vmapped") == 1
+    assert runner.query_cache.batched_launches == 2
 
 
 def test_nondeterministic_and_writes_never_batch(runner):
@@ -424,3 +439,413 @@ def test_optimizer_template_param_slots(runner):
     assert template_param_slots(plain) == ()
     assert any(name == "PlanTemplate"
                for name, _ in tmpl.root.optimizer_trace)
+
+
+# -- round 17: masked aggregation & join lanes ----------------------------
+
+
+def _star_runner(nfact=64, nhot=0, **kwargs):
+    """Star shape the batched join targets: a big param-filtered fact
+    (probe) against a small param-free dim (build — the cost-based join
+    order keeps the smaller side on the build)."""
+    r = _mem_runner(**kwargs)
+    r.execute("create table f (k bigint, v bigint)")
+    r.execute("create table d (k bigint, w bigint)")
+    r.execute("insert into f values "
+              + ", ".join("(%d, %d)" % (i % 4, i) for i in range(nfact)))
+    drows = ["(0, %d)" % i for i in range(nhot)] \
+        + ["(%d, %d)" % (k, k * 10) for k in (0, 1, 2, 3)]
+    r.execute("insert into d values " + ", ".join(drows))
+    return r
+
+
+def _rows(res):
+    return sorted(res.rows, key=repr)
+
+
+AGG_BURST = ["select k, count(*) c, sum(v) s from f where v > %d group by k"
+             % (i * 7) for i in range(8)]
+
+
+def test_batch_group_by_byte_equal_and_counted():
+    serial = _star_runner()
+    oracle = [_rows(serial.execute(s)) for s in AGG_BURST]
+    r = _star_runner()
+    out = r.execute_batch(AGG_BURST)
+    assert [_rows(o) for o in out] == oracle
+    assert r.query_cache.templates.dispositions.get(
+        "agg_stage_vmapped") == 1
+    assert not r.query_cache.templates.fallbacks
+    assert r.query_cache.batched_launches == 8
+    assert r.query_cache.batched_spills == 0
+
+
+def test_batch_agg_zero_new_traces_on_repeat():
+    """Repeat aggregating burst: ZERO new jit traces — the masked agg
+    kernels are cached by shape config, never by operator identity."""
+    r = _star_runner()
+    first = r.execute_batch(AGG_BURST)
+    before = jit_stats.counts()
+    again = r.execute_batch(AGG_BURST)
+    assert jit_stats.counts() == before, \
+        "repeat agg burst must not trace anything new"
+    assert [_rows(o) for o in again] == [_rows(o) for o in first]
+
+
+def test_batch_agg_null_group_keys():
+    """NULL group keys form their own group in every lane, byte-equal
+    to serial (the mask must not conflate invalid rows with NULLs)."""
+    serial, r = _star_runner(), _star_runner()
+    for q in (serial, r):
+        q.execute("insert into f values (null, 3), (null, 100), "
+                  "(null, 200)")
+    oracle = [_rows(serial.execute(s)) for s in AGG_BURST]
+    out = r.execute_batch(AGG_BURST)
+    assert [_rows(o) for o in out] == oracle
+
+
+def test_batch_agg_all_rows_masked_empty_lane():
+    """A member whose filter keeps ZERO rows yields an empty GROUP BY
+    result from its all-masked lane while sibling lanes aggregate."""
+    burst = ["select k, count(*) c from f where v > %d group by k" % x
+             for x in (10, 10 ** 6, 20)]
+    serial = _star_runner()
+    oracle = [_rows(serial.execute(s)) for s in burst]
+    assert oracle[1] == []
+    r = _star_runner()
+    out = r.execute_batch(burst)
+    assert [_rows(o) for o in out] == oracle
+    assert r.query_cache.templates.dispositions.get(
+        "agg_stage_vmapped") == 1
+
+
+@pytest.mark.parametrize("sql", [
+    "select f.v, d.w from f join d on f.k = d.k where f.v > %d",
+    "select f.v, d.w from f left join d on f.k = d.k where f.v > %d",
+    "select v from f where k in (select k from d) and v > %d",
+    "select v from f where k not in (select k from d) and v > %d",
+], ids=["inner", "left", "semi", "anti"])
+def test_batch_join_matrix_byte_equal(sql):
+    burst = [sql % (i * 11) for i in range(8)]
+    serial = _star_runner()
+    # anti needs probe keys missing from the dim to produce rows
+    oracle_extra = "insert into f values (7, 1), (8, 2), (9, 500)"
+    serial.execute(oracle_extra)
+    oracle = [_rows(serial.execute(s)) for s in burst]
+    r = _star_runner()
+    r.execute(oracle_extra)
+    out = r.execute_batch(burst)
+    assert [_rows(o) for o in out] == oracle
+    assert r.query_cache.templates.dispositions.get(
+        "join_stage_vmapped") == 1
+    assert not r.query_cache.templates.fallbacks
+
+
+def test_batch_join_then_group_by_one_pipeline():
+    """Join AND aggregation in the same pipeline both vmap: the probe
+    feeds masked expanded pages straight into the masked agg barrier."""
+    burst = ["select f.k, count(*) c, sum(d.w) s from f join d "
+             "on f.k = d.k where f.v > %d group by f.k" % (i * 17)
+             for i in range(8)]
+    serial = _star_runner()
+    oracle = [_rows(serial.execute(s)) for s in burst]
+    r = _star_runner()
+    out = r.execute_batch(burst)
+    assert [_rows(o) for o in out] == oracle
+    disp = r.query_cache.templates.dispositions
+    assert disp.get("join_stage_vmapped") == 1
+    assert disp.get("agg_stage_vmapped") == 1
+    assert not r.query_cache.templates.fallbacks
+
+
+def test_batch_lane_overflow_falls_back_alone():
+    """One member probes a hot build key hard enough to overflow the
+    unified expansion capacity: THAT lane alone replays serially
+    (counted ``lane_overflow``); sibling lanes keep their vmapped
+    results, all byte-equal."""
+    burst = ["select count(*) from f join d on f.k = d.k where f.v < %d"
+             % x for x in (16, 32, 16, 900)]
+    serial = _star_runner(1024, nhot=64)
+    oracle = [_rows(serial.execute(s)) for s in burst]
+    r = _star_runner(1024, nhot=64)
+    r.execute("set session join_max_expand_lanes = 1024")
+    out = r.execute_batch(burst)
+    assert [_rows(o) for o in out] == oracle
+    assert r.query_cache.batched_spills == 1
+    assert r.query_cache.templates.fallbacks.get("lane_overflow") == 1
+    # the two x=16 members coalesced; of the 3 lanes, 2 stayed vmapped
+    assert r.query_cache.batched_launches == 2
+
+
+def test_batch_agg_failing_member_demuxes_positionally():
+    r = _star_runner()
+    sqls = [AGG_BURST[0], "select nope from f group by k", AGG_BURST[2]]
+    out = r.execute_batch(sqls)
+    assert not isinstance(out[0], Exception)
+    assert isinstance(out[1], Exception)
+    assert not isinstance(out[2], Exception)
+    serial = _star_runner()
+    assert _rows(out[0]) == _rows(serial.execute(AGG_BURST[0]))
+    assert _rows(out[2]) == _rows(serial.execute(AGG_BURST[2]))
+
+
+def test_batch_agg_denied_member_demuxes_positionally():
+    """A member denied by ACL fails ONLY its own slot; the aggregating
+    siblings still ride the vmapped lane."""
+    acl = RuleBasedAccessControl([
+        TableRule(user="alice", table="f|d", privileges=["SELECT"]),
+    ])
+    seed = _star_runner()
+    seed.execute("create table secret (k bigint)")
+    seed.execute("insert into secret values (1)")
+    r = LocalQueryRunner(seed.metadata.connectors,
+                         Session(catalog="memory", schema="default"),
+                         access_control=acl)
+    out = r.execute_batch(
+        [AGG_BURST[0], "select k from secret", AGG_BURST[2]],
+        user="alice")
+    assert not isinstance(out[0], Exception)
+    assert isinstance(out[1], AccessDeniedError)
+    assert not isinstance(out[2], Exception)
+
+
+def test_batched_burst_records_hbo_actuals():
+    """Satellite 1: batched lanes feed HBO again — per-lane actuals are
+    EXACT mask popcounts (padded lanes excluded), recorded per member
+    under the shared statement fingerprint."""
+    from trino_tpu.telemetry import stats_store
+
+    stats_store.store().clear()
+    try:
+        r = _star_runner()
+        out = r.execute_batch(AGG_BURST[:4])
+        assert all(not isinstance(o, Exception) for o in out)
+        c = stats_store.store().counters()
+        assert c["records"] == 4, c
+        snap = stats_store.store().snapshot()
+        names = {e["name"] for e in snap}
+        assert "TableScanOperator" in names
+        assert "HashAggregationOperator" in names
+        assert all(e["rows"] >= 0 for e in snap)
+    finally:
+        stats_store.store().clear()
+
+
+def test_disposition_taxonomy_and_legacy_alias():
+    """Satellite 2: dispositions say what actually ran; the retired
+    ``non_fp_stage`` key stays scrapeable one release as an alias of
+    ``unsupported_stage``."""
+    r = _star_runner()
+    r.execute_batch(AGG_BURST)
+    r.execute_batch(["select v from f where k > %d order by v" % i
+                     for i in (1, 2)])
+    disp = r.query_cache.templates.dispositions
+    assert disp.get("agg_stage_vmapped") == 1
+    fb = r.query_cache.templates.fallbacks
+    assert fb.get("unsupported_stage") == 1
+    fams = {f["name"]: f for f in r.metrics_families()}
+    tmpl = fams["trino_plan_template_total"]
+    by_label = {tuple(sorted(labels.items())): value
+                for labels, value in tmpl["samples"]}
+    legacy = by_label.get((("outcome", "fallback:non_fp_stage"),))
+    assert legacy == 1, by_label
+    assert by_label.get((("outcome", "fallback:unsupported_stage"),)) \
+        == 1
+
+
+# -- round 17: distributed template-seed coherence ------------------------
+
+
+def test_template_seed_roundtrip_and_bounds():
+    from trino_tpu.cache import TemplateSeedStore
+
+    src = TemplateSeedStore()
+    for i in range(40):
+        src.note("fp%d" % i, i + 1)
+    src.note_fallback_shape("bad", "value_dependent")
+    seed = src.export_seed(max_shapes=8)
+    assert len(seed["shapes"]) == 8
+    hot = {fp for fp, _, _ in seed["shapes"][:7]}
+    assert hot <= {"fp%d" % i for i in range(32, 40)}
+    dst = TemplateSeedStore()
+    assert dst.import_seed(seed) == 8
+    assert dst.uses("fp39") == 40
+
+
+def test_template_seed_max_merge_and_first_verdict_wins():
+    """Use totals max-merge (a worker that observed MORE uses must not
+    regress); a locally proven fallback verdict is never overwritten by
+    a remote one."""
+    from trino_tpu.cache import TemplateSeedStore
+
+    dst = TemplateSeedStore()
+    dst.note("s", 10)
+    dst.note_fallback_shape("s", "string_param")
+    src = TemplateSeedStore()
+    src.note("s", 3)
+    src.note_fallback_shape("s", "value_dependent")
+    src.note("other", 7)
+    assert dst.import_seed(src.export_seed()) == 1   # only "other" news
+    assert dst.uses("s") == 10
+    assert dst.fallback_reason("s") == "string_param"
+    assert dst.uses("other") == 7
+
+
+def test_template_seed_malformed_warns_and_imports_nothing():
+    from trino_tpu.cache import TemplateSeedStore
+
+    dst = TemplateSeedStore()
+    with pytest.warns(RuntimeWarning, match="template seed"):
+        assert dst.import_seed({"shapes": [["fp"]]}) == 0
+    assert dst.corrupt_loads == 1
+    assert dst.uses("fp") == 0
+
+
+def test_seeded_runner_rides_template_on_first_statement():
+    """THE coherence contract: a fresh (replacement) runner whose seed
+    store carries an earned shape builds AND rides the template on its
+    very FIRST statement — no local re-earn of min_shape_uses."""
+    from trino_tpu.cache import template_seeds
+    from trino_tpu.telemetry import stats_store
+    from trino_tpu.telemetry.stats_store import statement_fingerprint
+
+    # without this, the HBO statement hint could admit the build on its
+    # own and mask a broken seed path
+    stats_store.store().clear()
+    probe = _mem_runner()
+    probe.execute("create table t (k bigint, v bigint)")
+    probe.execute("insert into t values (1, 10), (2, 20)")
+    pq = probe.query_cache.parse("select v from t where k = 1",
+                                 probe.session)
+    template_seeds().note(statement_fingerprint(pq.shape), 50)
+
+    r = _mem_runner()          # the "replacement worker"
+    r.execute("create table t (k bigint, v bigint)")
+    r.execute("insert into t values (1, 10), (2, 20)")
+    res = r.execute("select v from t where k = 2")
+    assert res.rows == [(20,)]
+    assert res.stats.get("plan_template") == "hit"
+    assert r.query_cache.templates.builds == 1
+
+
+def test_seeded_fallback_skips_local_trial():
+    """A cluster-proved value-dependent shape is negative-cached from
+    the seed WITHOUT paying a local trial plan (builds stays 0)."""
+    from trino_tpu.cache import template_seeds
+    from trino_tpu.telemetry.stats_store import statement_fingerprint
+
+    probe = _mem_runner()
+    probe.execute("create table t (k bigint, v bigint)")
+    probe.execute("insert into t values (1, 10), (2, 20)")
+    pq = probe.query_cache.parse("select v from t where k = 1",
+                                 probe.session)
+    fp = statement_fingerprint(pq.shape)
+    template_seeds().note(fp, 50)
+    template_seeds().note_fallback_shape(fp, "value_dependent")
+
+    r = _mem_runner()
+    r.execute("create table t (k bigint, v bigint)")
+    r.execute("insert into t values (1, 10), (2, 20)")
+    res = r.execute("select v from t where k = 2")
+    assert res.rows == [(20,)]
+    assert r.query_cache.templates.builds == 0
+    assert r.query_cache.templates.fallbacks.get("value_dependent") == 1
+
+
+def test_template_seed_disabled_by_session_property():
+    from trino_tpu.cache import template_seeds
+    from trino_tpu.telemetry import stats_store
+    from trino_tpu.telemetry.stats_store import statement_fingerprint
+
+    # the HBO statement hint is its own first-use admission path (PR
+    # 15); clear the process store so THIS test isolates the seed knob
+    stats_store.store().clear()
+    probe = _mem_runner()
+    probe.execute("create table t (k bigint, v bigint)")
+    probe.execute("insert into t values (1, 10)")
+    pq = probe.query_cache.parse("select v from t where k = 1",
+                                 probe.session)
+    template_seeds().note(statement_fingerprint(pq.shape), 50)
+
+    r = _mem_runner()
+    r.execute("create table t (k bigint, v bigint)")
+    r.execute("insert into t values (1, 10)")
+    r.execute("set session plan_template_seed_enabled = false")
+    res = r.execute("select v from t where k = 1")
+    assert res.rows == [(10,)]
+    # first use, seed ignored: below min_shape_uses, no build
+    assert res.stats.get("plan_template") is None
+    assert r.query_cache.templates.builds == 0
+
+
+def test_worker_configure_imports_template_seed_over_rpc():
+    """The real configure handler: a template_seed payload lands in the
+    worker-process seed store and the response reports the count —
+    mirroring the HBO seed transport."""
+    import threading
+
+    from trino_tpu.cache import template_seeds
+    from trino_tpu.parallel.rpc import call
+    from trino_tpu.parallel.worker import WorkerServer
+
+    from trino_tpu.cache import TemplateSeedStore
+    src = TemplateSeedStore()
+    src.note("seeded-shape", 9)
+    template_seeds().clear()
+    server = WorkerServer(0)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        resp = call(("127.0.0.1", server.port), {
+            "op": "configure", "catalogs": {}, "properties": {},
+            "template_seed": src.export_seed()})
+        assert resp["ok"] and resp["template_seeded"] == 1
+        # in-process server shares this process's store
+        assert template_seeds().uses("seeded-shape") == 9
+        # heartbeat path: a DELTA seed rides the ping the same way
+        src.note("hotter-shape", 4)
+        resp2 = call(("127.0.0.1", server.port), {
+            "op": "ping", "template_seed": src.export_seed()})
+        assert resp2["ok"] and resp2.get("template_seeded") == 1
+        assert template_seeds().uses("hotter-shape") == 4
+    finally:
+        server.server.shutdown()
+        template_seeds().clear()
+
+
+@pytest.mark.slow
+def test_process_runner_ships_template_seed_to_replacement_worker():
+    """E2E over real worker subprocesses: after the coordinator earns a
+    shape, a worker spawned NOW (the replacement path) receives the
+    template seed at configure — and the heartbeat ships deltas to
+    stale workers without re-sending an unchanged seed."""
+    from trino_tpu.cache import template_seeds
+    from trino_tpu.parallel.process_runner import ProcessQueryRunner
+
+    catalogs = {"tpch": {"connector": "tpch", "page_rows": 4096}}
+    runner = ProcessQueryRunner(
+        catalogs, Session(catalog="tpch", schema="micro"),
+        n_workers=2, desired_splits=4)
+    new = None
+    try:
+        # initial workers spawned against an empty seed store
+        assert all(w.template_seeded == 0 for w in runner.workers)
+        template_seeds().note("earned-shape", 25)
+        new = runner._spawn_worker_process(generation=1)
+        assert new.template_seeded >= 1
+        assert new.template_seed_version == template_seeds().version
+        # the ORIGINAL workers are stale: one heartbeat catches them up
+        stale = [w for w in runner.workers if w is not new]
+        assert any(w.template_seed_version < template_seeds().version
+                   for w in stale)
+        runner.heartbeat()
+        assert all(w.template_seed_version == template_seeds().version
+                   for w in runner.workers)
+        # steady state: a second heartbeat has no delta to ship
+        v = template_seeds().version
+        runner.heartbeat()
+        assert all(w.template_seed_version == v for w in runner.workers)
+    finally:
+        if new is not None:
+            new.proc.kill()
+        runner.close()
+        template_seeds().clear()

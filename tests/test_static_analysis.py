@@ -1508,8 +1508,16 @@ def test_gate_passes_are_not_blind_on_the_real_repo(repo_findings):
                    "join_probe_counts", "join_expand_matches",
                    "matmul_join_probe", "grouped_topn_kernel",
                    "device_exchange_program", "device_exchange_count",
-                   "mesh_q1_stage1", "segment_reduce_pallas"):
+                   "mesh_q1_stage1", "segment_reduce_pallas",
+                   # round 17: masked agg/join lanes register through
+                   # the _batched_kernel facade (jit(vmap(...)) wraps)
+                   # — the facade-resolving walker must NOT go blind
+                   "batched_agg_partial", "batched_agg_merge",
+                   "batched_agg_finalize", "batched_join_probe",
+                   "batched_join_expand", "batched_join_semi"):
         assert kernel in profiled, kernel
+    assert all(m == "trino_tpu.exec.batched"
+               for m in profiled["batched_agg_partial"])
 
 
 def test_hbo_record_path_indexed_and_outside_jit(repo_findings):

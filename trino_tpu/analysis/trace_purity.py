@@ -85,6 +85,30 @@ def profiled_entries(index: ProjectIndex) -> Dict[str, List[str]]:
     silently blind EXPLAIN ANALYZE VERBOSE and the bench flight
     recorder)."""
     out: Dict[str, List[str]] = {}
+    # registration FACADES (round 17): a function whose body forwards
+    # its own parameter as instrument()'s name — e.g. exec/batched.py
+    # ``_batched_kernel(name, cfg, build_lane)`` wrapping every masked
+    # agg/join kernel in ``instrument(name, jit(vmap(...)))``.  Calls
+    # to such a facade with a CONSTANT name register that name: one-hop
+    # dataflow, so the floor test still pins the literal kernel names
+    # instead of going blind behind the helper.
+    facades: Dict[str, int] = {}
+    for mod_name in sorted(index.modules):
+        mod = index.modules[mod_name]
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            params = [a.arg for a in node.args.args]
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                chain = dotted_chain(call.func)
+                if chain is None \
+                        or chain.split(".")[-1] != "instrument":
+                    continue
+                if call.args and isinstance(call.args[0], ast.Name) \
+                        and call.args[0].id in params:
+                    facades[node.name] = params.index(call.args[0].id)
     for mod_name in sorted(index.modules):
         mod = index.modules[mod_name]
         # walk the whole module tree: most registrations are module-
@@ -94,11 +118,17 @@ def profiled_entries(index: ProjectIndex) -> Dict[str, List[str]]:
             if not isinstance(node, ast.Call):
                 continue
             chain = dotted_chain(node.func)
-            if chain is None or chain.split(".")[-1] != "instrument":
+            if chain is None:
                 continue
-            if node.args and isinstance(node.args[0], ast.Constant) \
-                    and isinstance(node.args[0].value, str):
-                out.setdefault(node.args[0].value, []).append(mod_name)
+            leaf = chain.split(".")[-1]
+            pos = 0 if leaf == "instrument" else facades.get(leaf)
+            if pos is None:
+                continue
+            if len(node.args) > pos \
+                    and isinstance(node.args[pos], ast.Constant) \
+                    and isinstance(node.args[pos].value, str):
+                out.setdefault(node.args[pos].value,
+                               []).append(mod_name)
     return out
 
 

@@ -446,6 +446,7 @@ class TemplateCache:
         self.misses = 0
         self.builds = 0
         self.fallbacks: Dict[str, int] = {}
+        self.dispositions: Dict[str, int] = {}
 
     def lookup(self, key):
         """-> ("hit", PlanTemplate) | ("fallback", reason) | None."""
@@ -481,6 +482,15 @@ class TemplateCache:
         with self._lock:
             self.fallbacks[reason] = self.fallbacks.get(reason, 0) + 1
 
+    def note_disposition(self, reason: str):
+        """Count HOW a batched launch executed beyond filter/project
+        stages (``agg_stage_vmapped`` / ``join_stage_vmapped``) — the
+        positive half of the ``non_fp_stage`` split (round 17): the
+        metric family shows vmapped agg/join launches next to the
+        ``unsupported_stage`` fallbacks they replaced."""
+        with self._lock:
+            self.dispositions[reason] = self.dispositions.get(reason, 0) + 1
+
     def note_uses(self, shape, n: int = 1) -> int:
         """Count ``n`` submissions of ``shape``; returns the running
         total (a batch of B counts as B uses — a same-shape burst is
@@ -508,6 +518,130 @@ class TemplateCache:
             return len(self._entries)
 
 
+class TemplateSeedStore:
+    """Process-wide template-earn state shared across the cluster
+    (round 17): the coordinator's per-shape use totals and negative
+    (fallback) verdicts, keyed by ``statement_fingerprint(shape)`` so
+    the payload is JSON-safe and process-independent.
+
+    Transport mirrors the HBO seed (PR 15): the coordinator exports a
+    bounded snapshot that piggybacks on worker ``configure()`` and on
+    the heartbeat when the local version advanced, so a REPLACEMENT
+    worker rides an already-earned template on its first statement
+    instead of re-earning ``batched_execution_min_shape_uses``
+    locally — and skips shapes the cluster already proved
+    value-dependent without paying its own trial plan.
+
+    Merge discipline is max-wins (use totals only ever grow; the max of
+    two counters is a sound lower bound of true cluster-wide uses) and
+    a remote fallback verdict never overwrites a local one (the local
+    process observed its own trial).  All mutation holds ``_lock`` —
+    readers race with the heartbeat exporter otherwise.
+    """
+
+    MAX_SHAPES = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._uses: Dict[str, int] = {}
+        self._fallbacks: Dict[str, str] = {}
+        self.version = 0          # bumps on any growth: heartbeat delta gate
+        self.corrupt_loads = 0
+
+    def note(self, fp: str, total: int):
+        with self._lock:
+            cur = self._uses.get(fp, 0)
+            if total > cur:
+                self._uses[fp] = total
+                self.version += 1
+            self._trim()
+
+    def note_fallback_shape(self, fp: str, reason: str):
+        with self._lock:
+            if fp not in self._fallbacks:
+                self._fallbacks[fp] = reason
+                self.version += 1
+
+    def uses(self, fp: str) -> int:
+        with self._lock:
+            return self._uses.get(fp, 0)
+
+    def fallback_reason(self, fp: str) -> Optional[str]:
+        with self._lock:
+            return self._fallbacks.get(fp)
+
+    def _trim(self):
+        # caller holds _lock; bound like TemplateCache._shape_uses
+        if len(self._uses) > self.MAX_SHAPES:
+            keep = sorted(self._uses.items(), key=lambda kv: kv[1],
+                          reverse=True)[:self.MAX_SHAPES // 2]
+            self._uses = dict(keep)
+
+    def export_seed(self, max_shapes: int = 64) -> dict:
+        """Bounded JSON-safe snapshot of the HOTTEST shapes (use totals
+        are the heat signal the admission policy consults)."""
+        with self._lock:
+            hot = sorted(self._uses.items(), key=lambda kv: kv[1],
+                         reverse=True)[:max_shapes]
+            shapes = [[fp, int(n), self._fallbacks.get(fp)]
+                      for fp, n in hot]
+            for fp, reason in self._fallbacks.items():
+                if len(shapes) >= max_shapes:
+                    break
+                if fp not in self._uses:
+                    shapes.append([fp, 0, reason])
+            return {"version": 1, "shapes": shapes}
+
+    def import_seed(self, payload: dict) -> int:
+        """Fold a coordinator seed in; returns how many shapes carried
+        NEW information (higher total or a fresh verdict).  A malformed
+        payload warns loudly and imports nothing (the HBO seed's
+        half-load rule)."""
+        import warnings
+
+        try:
+            rows = [(str(fp), int(n), None if reason is None
+                     else str(reason))
+                    for fp, n, reason in payload["shapes"]]
+        except (ValueError, KeyError, TypeError) as e:
+            with self._lock:
+                self.corrupt_loads += 1
+            warnings.warn(
+                f"template seed payload is malformed and was IGNORED: "
+                f"{e!r}", RuntimeWarning, stacklevel=2)
+            return 0
+        imported = 0
+        with self._lock:
+            for fp, n, reason in rows:
+                grew = False
+                if n > self._uses.get(fp, 0):
+                    self._uses[fp] = n
+                    grew = True
+                if reason is not None and fp not in self._fallbacks:
+                    self._fallbacks[fp] = reason
+                    grew = True
+                if grew:
+                    imported += 1
+                    self.version += 1
+            self._trim()
+        return imported
+
+    def clear(self):
+        with self._lock:
+            self._uses.clear()
+            self._fallbacks.clear()
+            self.version = 0
+
+
+#: the process-wide seed store (coordinator and workers each own one,
+#: like ``telemetry.stats_store.store()``); tests reset via ``clear()``
+_TEMPLATE_SEEDS = TemplateSeedStore()
+
+
+def template_seeds() -> TemplateSeedStore:
+    return _TEMPLATE_SEEDS
+
+
 class QueryCache:
     """Per-runner facade: parse memo + plan cache + result cache +
     shared-processor cache, with one metrics surface.  Owned by
@@ -528,6 +662,7 @@ class QueryCache:
         self.batches = 0            # admission batches executed
         self.batched_queries = 0    # statements that rode a batch
         self.batched_launches = 0   # statements served by ONE vmapped launch
+        self.batched_spills = 0     # lanes that overflowed a unified capacity
         self.result_shortcircuits = 0  # batch members served from result cache
 
     def parse(self, sql: str, session) -> ParsedQuery:
@@ -605,6 +740,7 @@ class QueryCache:
             "batched_queries": self.batched_queries,
             "coalesced": self.coalesced,
             "batched_launches": self.batched_launches,
+            "batched_spills": self.batched_spills,
             "result_shortcircuits": self.result_shortcircuits,
             "template_hits": self.templates.hits,
             "template_misses": self.templates.misses,
@@ -654,15 +790,26 @@ class QueryCache:
         b.inc(c["batched_queries"], kind="queries")
         b.inc(c["coalesced"], kind="coalesced")
         b.inc(c["batched_launches"], kind="vmapped")
+        b.inc(c["batched_spills"], kind="spilled")
         b.inc(c["result_shortcircuits"], kind="result_shortcircuit")
         t = reg.counter("trino_plan_template_total",
                         "Plan-template lookups/builds by outcome "
-                        "(hit|miss|build|fallback:<reason>)")
+                        "(hit|miss|build|fallback:<reason>|"
+                        "disposition:<reason>)")
         t.inc(c["template_hits"], outcome="hit")
         t.inc(c["template_misses"], outcome="miss")
         t.inc(c["template_builds"], outcome="build")
         for reason, n in sorted(self.templates.fallbacks.items()):
             t.inc(n, outcome=f"fallback:{reason}")
+        # round-17 taxonomy split: ``non_fp_stage`` became
+        # ``unsupported_stage`` (+ the vmapped dispositions below);
+        # export the old key as an alias for one release so dashboards
+        # keyed on it keep reading during the rename
+        legacy = self.templates.fallbacks.get("unsupported_stage", 0)
+        if legacy:
+            t.inc(legacy, outcome="fallback:non_fp_stage")
+        for reason, n in sorted(self.templates.dispositions.items()):
+            t.inc(n, outcome=f"disposition:{reason}")
         reg.gauge("trino_plan_template_entries",
                   "Plan-template resident entries (positive + "
                   "negative)").set(c["template_entries"])

@@ -45,6 +45,17 @@ def param_raw(t: T.Type, v):
     return np.asarray(v, dtype=t.storage)[()]
 
 
+def pad_lut(raw: np.ndarray, minimum: int = 8) -> np.ndarray:
+    """Pad a host LUT to a power-of-two length so LUT uploads hit a
+    bounded set of jit shapes (the same bucketing ``padded_size``
+    applies to row counts).  Shared by ``PageProcessor._fill_luts`` and
+    the batched executor's rank/inverse LUT uploads."""
+    cap = padded_size(max(len(raw), 1), minimum=minimum)
+    arr = np.zeros(cap, dtype=raw.dtype)
+    arr[:len(raw)] = raw
+    return arr
+
+
 def _is_string(t: T.Type) -> bool:
     return t.is_string
 
@@ -865,10 +876,7 @@ class PageProcessor:
                        tuple(len(d) for d in dicts if d is not None))
                 arr = self._lut_cache.get(key)
                 if arr is None:
-                    raw = slot.fill(dicts)
-                    cap = padded_size(max(len(raw), 1), minimum=8)
-                    arr = np.zeros(cap, dtype=raw.dtype)
-                    arr[:len(raw)] = raw
+                    arr = pad_lut(slot.fill(dicts))
                     self._lut_cache[key] = arr
                     if len(self._lut_cache) > 256:
                         self._lut_cache.clear()

@@ -215,8 +215,14 @@ def _rank_and_inverse(dictionary):
     return ranks, inv
 
 
-def _init_states(agg: AggCall, cols, nulls, valid, dicts=None) -> List:
-    """Per-row initial state columns for one aggregate."""
+def _init_states(agg: AggCall, cols, nulls, valid, dicts=None,
+                 rank_lut=None) -> List:
+    """Per-row initial state columns for one aggregate.
+
+    ``rank_lut``: precomputed lexicographic-rank LUT ARRAY for a pooled
+    min/max arg (the batched executor passes it as a traced vmap
+    operand so the host-side ``_rank_and_inverse`` pool walk never runs
+    inside a trace); None = derive it from ``dicts`` on host."""
     f = agg.function
     if f == "count_star":
         return [valid.astype(jnp.int64)]
@@ -254,8 +260,9 @@ def _init_states(agg: AggCall, cols, nulls, valid, dicts=None) -> List:
         if agg.arg_type is not None and agg.arg_type.is_pooled:
             # reduce on lexicographic RANKS (codes are pool-order);
             # _map_rank_states restores codes after the reduce
-            rank_lut, _ = _rank_and_inverse(
-                dicts[agg.arg_channel] if dicts is not None else None)
+            if rank_lut is None:
+                rank_lut, _ = _rank_and_inverse(
+                    dicts[agg.arg_channel] if dicts is not None else None)
             ranks = jnp.asarray(rank_lut)[raw]
             info = jnp.iinfo(jnp.int64)
             sent = info.max if f == "min" else info.min
@@ -278,13 +285,17 @@ def _init_states(agg: AggCall, cols, nulls, valid, dicts=None) -> List:
     return [x, x * x, live.astype(jnp.int64)]
 
 
-def _merge_states(agg: AggCall, state_cols, valid, state_dicts=None) -> List:
+def _merge_states(agg: AggCall, state_cols, valid, state_dicts=None,
+                  rank_luts=None) -> List:
     """Partial-state columns re-entering a (final) aggregation: states
     combine with their own reduce kinds. min/max values are neutralized
     to their sentinel on invalid lanes AND on empty partials (count
     state 0 — e.g. the one empty-input row a global partial emits),
     which would otherwise contribute a bogus 0. String min/max states
-    arrive as codes and re-enter the reduce as lexicographic ranks."""
+    arrive as codes and re-enter the reduce as lexicographic ranks.
+    ``rank_luts``: per-state precomputed rank LUT arrays (traced vmap
+    operands, see ``_init_states``); None = derive from
+    ``state_dicts`` on host."""
     plan = _state_plan(agg)
     count = state_cols[-1]  # every aggregate's last state is its count
     is_str = agg.arg_type is not None and agg.arg_type.is_pooled
@@ -296,8 +307,11 @@ def _merge_states(agg: AggCall, state_cols, valid, state_dicts=None) -> List:
         else:
             live = valid & (count > 0)
             if is_str and kind in ("min", "max"):
-                rank_lut, _ = _rank_and_inverse(
-                    state_dicts[j] if state_dicts is not None else None)
+                rank_lut = rank_luts[j] if rank_luts is not None else None
+                if rank_lut is None:
+                    rank_lut, _ = _rank_and_inverse(
+                        state_dicts[j] if state_dicts is not None
+                        else None)
                 s = jnp.asarray(rank_lut)[s]
                 info = jnp.iinfo(jnp.int64)
                 sent = info.max if kind == "min" else info.min
@@ -354,17 +368,20 @@ def _final_project(agg: AggCall, states: List):
 # the grouping kernel
 
 
-@partial(jax.jit, static_argnames=("num_states", "num_keys", "kinds",
-                                   "pallas"))
-def _group_reduce(key_ops: Tuple, key_raws: Tuple, state_cols: Tuple,
-                  valid, num_keys: int, num_states: int, kinds: Tuple,
-                  pallas: str = ""):
+def _group_reduce_impl(key_ops: Tuple, key_raws: Tuple,
+                       state_cols: Tuple, valid, num_keys: int,
+                       num_states: int, kinds: Tuple, pallas: str = ""):
     """Sort-group-reduce one batch.
 
     key_ops: flattened (null_bit, u64) pairs for each group key
     key_raws: the raw key columns (carried through the sort)
     state_cols: per-row state columns (carried through the sort)
     Returns (group_key_raws, group_key_nullbits, reduced_states, out_valid).
+
+    Raw implementation: the batched executor composes it under its own
+    ``jit(vmap(...))`` wrappers (calling the instrumented binding
+    inside a trace would run profiler host bookkeeping per lane); host
+    callers use the jitted+instrumented ``_group_reduce`` below.
     """
     jit_stats.bump("sort_group_reduce")
     cap = valid.shape[0]
@@ -413,8 +430,27 @@ def _group_reduce(key_ops: Tuple, key_raws: Tuple, state_cols: Tuple,
 
 
 _group_reduce = instrument(
-    "sort_group_reduce", _group_reduce,
+    "sort_group_reduce",
+    partial(jax.jit, static_argnames=("num_states", "num_keys", "kinds",
+                                      "pallas"))(_group_reduce_impl),
     static_argnames=("num_states", "num_keys", "kinds", "pallas"))
+
+
+def _ranks_to_codes(state_cols: List, str_state: Sequence[bool],
+                    inv_luts: Sequence) -> List:
+    """String min/max value states: lexicographic RANK -> the
+    representative CODE, driven by precomputed inverse LUT ARRAYS (the
+    trace-safe mirror of ``HashAggregationOperator._states_rank_to_code``
+    — the batched executor passes the LUTs as traced vmap operands).
+    Dead/sentinel lanes clamp into range; count==0 nulls them
+    downstream. LUTs keep their EXACT pool length so the clamp bound
+    matches the host path bit-for-bit."""
+    for k, is_str in enumerate(str_state):
+        if is_str:
+            inv = inv_luts[k]
+            r = jnp.clip(state_cols[k], 0, inv.shape[0] - 1)
+            state_cols[k] = inv[r].astype(jnp.int32)
+    return state_cols
 
 
 @partial(jax.jit, static_argnames=("buckets",))

@@ -81,10 +81,16 @@ def _mix_operands(key_ops: Tuple, n: int):
     return h
 
 
-@partial(jax.jit, static_argnames=("rounds", "exact"))
-def hash_group_ids(key_ops: Tuple, valid, rounds: int = PROBE_ROUNDS,
-                   exact: bool = True):
+def _hash_group_ids_impl(key_ops: Tuple, valid,
+                         rounds: int = PROBE_ROUNDS,
+                         exact: bool = True):
     """Vectorized insert-or-lookup over one page.
+
+    Raw (un-jitted, un-instrumented) implementation: the batched
+    executor composes it under its own ``jit(vmap(...))`` wrappers —
+    calling the instrumented public name inside a trace would run the
+    profiler's host bookkeeping per vmap lane. Host callers use the
+    ``hash_group_ids`` binding below.
 
     key_ops: flattened (tag_u8, u64) grouping operands (integer dtypes).
     valid:   bool lane mask; invalid lanes get the dump gid ``capacity``.
@@ -169,15 +175,20 @@ def hash_group_ids(key_ops: Tuple, valid, rounds: int = PROBE_ROUNDS,
 
 # profiled entry points (telemetry.profiler): cost/compile
 # attribution under EXPLAIN ANALYZE VERBOSE; plain calls when off
-hash_group_ids = instrument("hash_group_ids", hash_group_ids,
-                            static_argnames=("rounds", "exact"))
+hash_group_ids = instrument(
+    "hash_group_ids",
+    partial(jax.jit, static_argnames=("rounds", "exact"))(
+        _hash_group_ids_impl),
+    static_argnames=("rounds", "exact"))
 
 
-@partial(jax.jit, static_argnames=("kinds", "pallas"))
-def hash_segment_reduce(gid, group_rows, ngroups, key_raws: Tuple,
-                        key_nulls: Tuple, state_cols: Tuple, kinds: Tuple,
-                        pallas: str = ""):
+def _hash_segment_reduce_impl(gid, group_rows, ngroups, key_raws: Tuple,
+                              key_nulls: Tuple, state_cols: Tuple,
+                              kinds: Tuple, pallas: str = ""):
     """Reduce state columns by hash-assigned gid and gather group keys.
+
+    Raw implementation (see ``_hash_group_ids_impl`` for why); host
+    callers use the jitted+instrumented ``hash_segment_reduce`` below.
 
     The Pallas segment kernel requires non-decreasing gids (steps <= 1),
     so when it is active the states take one cheap single-operand sort
@@ -214,5 +225,7 @@ def hash_segment_reduce(gid, group_rows, ngroups, key_raws: Tuple,
 
 
 hash_segment_reduce = instrument(
-    "hash_segment_reduce", hash_segment_reduce,
+    "hash_segment_reduce",
+    partial(jax.jit, static_argnames=("kinds", "pallas"))(
+        _hash_segment_reduce_impl),
     static_argnames=("kinds", "pallas"))

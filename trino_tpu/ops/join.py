@@ -118,8 +118,13 @@ def _build_sorted(key_u64, anynull, cols, nulls, valid):
 _build_sorted = instrument("join_build_sorted", _build_sorted)
 
 
-@jax.jit
-def _probe_counts(build_keys, build_usable, probe_keys, probe_usable):
+# Raw (un-jitted, un-instrumented) probe-kernel implementations: the
+# batched executor composes them under its own jit(vmap(...)) wrappers
+# with the build arrays broadcast (in_axes=None), so one param-free
+# build serves every lane of a literal batch. Host callers use the
+# jitted+instrumented bindings below.
+def _probe_counts_impl(build_keys, build_usable, probe_keys,
+                       probe_usable):
     from .. import jit_stats
 
     jit_stats.bump("join_probe_counts")
@@ -129,11 +134,11 @@ def _probe_counts(build_keys, build_usable, probe_keys, probe_usable):
     return lo, count
 
 
-_probe_counts = instrument("join_probe_counts", _probe_counts)
+_probe_counts = instrument("join_probe_counts",
+                           jax.jit(_probe_counts_impl))
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def _expand_matches(lo, count, out_cap: int):
+def _expand_matches_impl(lo, count, out_cap: int):
     """Candidate pairs: output lane j -> (probe_row, build_row)."""
     from .. import jit_stats
 
@@ -150,8 +155,10 @@ def _expand_matches(lo, count, out_cap: int):
             jnp.clip(build_idx, 0, None).astype(jnp.int32), lane_valid)
 
 
-_expand_matches = instrument("join_expand_matches", _expand_matches,
-                             static_argnames=("out_cap",))
+_expand_matches = instrument(
+    "join_expand_matches",
+    partial(jax.jit, static_argnames=("out_cap",))(_expand_matches_impl),
+    static_argnames=("out_cap",))
 
 
 @dataclass
@@ -683,14 +690,16 @@ class LookupJoinOperator(Operator):
                            out_valid, dicts), keep, build_idx)
 
 
-@partial(jax.jit, static_argnames=("left",))
-def _finalize_join(pcols, pnulls, pvalid, bcols, bnulls,
-                   probe_idx, build_idx, keep, left: bool):
+def _finalize_join_impl(pcols, pnulls, pvalid, bcols, bnulls,
+                        probe_idx, build_idx, keep, left: bool):
     """Gather joined output lanes; for LEFT, append one lane per probe
-    row, valid iff the row matched no kept lane (NULL build columns)."""
+    row, valid iff the row matched no kept lane (NULL build columns).
+
+    Raw implementation (see ``_probe_counts_impl``); host callers use
+    the jitted ``_finalize_join`` binding below."""
     lane_cap = probe_idx.shape[0]
     if left:
-        matched = _segment_any(keep, probe_idx, pvalid.shape[0])
+        matched = _segment_any_impl(keep, probe_idx, pvalid.shape[0])
         n_extra = pvalid.shape[0]
         extra_probe = jnp.arange(n_extra, dtype=probe_idx.dtype)
         probe_idx = jnp.concatenate([probe_idx, extra_probe])
@@ -710,6 +719,10 @@ def _finalize_join(pcols, pnulls, pvalid, bcols, bnulls,
     return out_cols, out_nulls, keep
 
 
+_finalize_join = partial(jax.jit, static_argnames=("left",))(
+    _finalize_join_impl)
+
+
 def _gather_lanes(page: DevicePage, b: "BuildSide", probe_idx, build_idx,
                   keep) -> DevicePage:
     """Combined probe+build rows for candidate lanes (residual-filter
@@ -724,15 +737,22 @@ def _gather_lanes(page: DevicePage, b: "BuildSide", probe_idx, build_idx,
         list(page.dictionaries) + list(b.dictionaries))
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
-def _expand_verified(lo, count, pkey_cols, bkey_cols, out_cap: int):
+def _expand_verified_impl(lo, count, pkey_cols, bkey_cols, out_cap: int):
     """Candidate lanes with raw-key verification applied (for
-    residual-filtered semi/anti joins)."""
-    probe_idx, build_idx, lane_valid = _expand_matches(lo, count, out_cap)
+    residual-filtered semi/anti joins).
+
+    Raw implementation (see ``_probe_counts_impl``); host callers use
+    the jitted ``_expand_verified`` binding below."""
+    probe_idx, build_idx, lane_valid = _expand_matches_impl(
+        lo, count, out_cap)
     keep = lane_valid
     for pc, bc in zip(pkey_cols, bkey_cols):
         keep = keep & (pc[probe_idx] == bc[build_idx])
     return probe_idx, build_idx, keep
+
+
+_expand_verified = partial(jax.jit, static_argnames=("out_cap",))(
+    _expand_verified_impl)
 
 
 @jax.jit
@@ -743,26 +763,36 @@ def _mark_build_matched(acc, keep, build_idx):
     return acc.at[jnp.where(keep, build_idx, sink)].max(True)
 
 
-@partial(jax.jit, static_argnames=("probe_cap",))
-def _segment_any(keep, probe_idx, probe_cap: int):
+def _segment_any_impl(keep, probe_idx, probe_cap: int):
     """OR of ``keep`` lanes per probe row."""
     matched = jnp.zeros(probe_cap + 1, dtype=bool)
     matched = matched.at[jnp.where(keep, probe_idx, probe_cap)].max(True)
     return matched[:-1]
 
 
-@partial(jax.jit, static_argnames=("probe_cap", "out_cap"))
-def _semi_matched(lo, count, pkey_cols, bkey_cols, probe_cap: int,
-                  out_cap: int):
+_segment_any = partial(jax.jit, static_argnames=("probe_cap",))(
+    _segment_any_impl)
+
+
+def _semi_matched_impl(lo, count, pkey_cols, bkey_cols, probe_cap: int,
+                       out_cap: int):
     """Per-probe-row matched flag: expand candidates, verify raw keys,
-    segment-OR back onto probe rows (collision-safe for any key mode)."""
-    probe_idx, build_idx, lane_valid = _expand_matches(lo, count, out_cap)
+    segment-OR back onto probe rows (collision-safe for any key mode).
+
+    Raw implementation (see ``_probe_counts_impl``); host callers use
+    the jitted ``_semi_matched`` binding below."""
+    probe_idx, build_idx, lane_valid = _expand_matches_impl(
+        lo, count, out_cap)
     keep = lane_valid
     for pc, bc in zip(pkey_cols, bkey_cols):
         keep = keep & (pc[probe_idx] == bc[build_idx])
     matched = jnp.zeros(probe_cap + 1, dtype=bool)
     matched = matched.at[jnp.where(keep, probe_idx, probe_cap)].max(True)
     return matched[:-1]
+
+
+_semi_matched = partial(jax.jit, static_argnames=("probe_cap", "out_cap"))(
+    _semi_matched_impl)
 
 
 def _pad_dev(arr, cap: int):

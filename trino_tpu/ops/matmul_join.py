@@ -36,6 +36,14 @@ Adaptation to this engine's join machinery (``ops/join.py``):
 
 Static one-hot width (the jit cache key) rides ``KERNEL_SIZING`` so
 repeat queries with a jittering key range reuse the compiled program.
+
+Batched execution (round 17): ``exec/batched.py`` probes every join —
+matmul-strategy or not — through the shared sorted-index impls
+(``_probe_counts_impl`` et al.) under one ``jit(vmap(...))`` program.
+That is sound precisely because of the bit-identity above: ``(lo,
+count)`` from the matmul probe equals the sorted-index result byte for
+byte, so a burst may ride the masked sorted-index lane while the
+serial path keeps the MXU probe, with byte-equal demuxed pages.
 """
 
 from __future__ import annotations

@@ -10,6 +10,13 @@ fragment, i.e. the spooled-exchange (fault-tolerant) execution shape;
 the streaming pipelined overlap and the device-collective all_to_all
 boundary (parallel/exchange.py) layer on top of the same fragment
 contract.
+
+Cache-coherence note (round 17): in-process workers share this
+process's ``cache.template_seeds()`` and ``telemetry.stats_store``
+singletons, so template-earn state and HBO history are trivially
+coherent here — the configure()/heartbeat seed piggyback lives in the
+multi-process runner (``parallel/process_runner.py``), where each
+worker owns its own stores.
 """
 
 from __future__ import annotations

@@ -112,6 +112,12 @@ class WorkerHandle:
         #: committed pages this worker's replica already holds, so
         #: append-only commits ship only the tail (not O(N^2) re-sends)
         self.synced: Dict[Tuple[str, str, str], int] = {}
+        #: seed-import observability (set at configure time): how many
+        #: HBO statements / template shapes the worker imported, and
+        #: the template-seed version last shipped (heartbeat delta gate)
+        self.hbo_seeded = 0
+        self.template_seeded = 0
+        self.template_seed_version = 0
 
     def rpc(self, request: dict, timeout: float = 600.0) -> dict:
         return call(self.addr, request, timeout=timeout)
@@ -340,10 +346,26 @@ class ProcessQueryRunner:
             seed = _hbo_store().export_seed()
             if seed["statements"]:
                 cfg["hbo_seed"] = seed
+        from ..cache import template_seeds as _tseeds
+
+        if (SP.value(self.session, "plan_template_enabled")  # qlint: ignore[cache-coherence] same LIVE-flag rule as hbo_enabled above: SET SESSION can flip the knobs after construction
+                and SP.value(self.session, "plan_template_seed_enabled")):  # qlint: ignore[cache-coherence] same LIVE-flag rule as hbo_enabled above
+            # template-earn state rides beside the HBO seed (round 17):
+            # a replacement worker rides already-earned plan templates
+            # on its first statement instead of re-earning
+            # min_shape_uses locally
+            tseed = _tseeds().export_seed()
+            if tseed["shapes"]:
+                cfg["template_seed"] = tseed
         resp = handle.rpc(cfg, timeout=60)
         #: statements the seed actually imported into the worker's
         #: store (observability: tests + replacement-worker freshness)
         handle.hbo_seeded = int(resp.get("hbo_seeded") or 0)
+        #: shapes the template seed imported (same observability role)
+        handle.template_seeded = int(resp.get("template_seeded") or 0)
+        #: template-seed version last shipped to this worker — the
+        #: heartbeat re-ships only when the local store has advanced
+        handle.template_seed_version = _tseeds().version
         return handle
 
     def _spawn_workers(self):
@@ -519,13 +541,38 @@ class ProcessQueryRunner:
         piggybacks the worker's memory-pool snapshot into the
         ClusterMemoryManager (no extra RPC)."""
         ok = []
+        # template-earn deltas ride the heartbeat (round 17): workers
+        # whose last-shipped seed version lags the local store get the
+        # fresh snapshot piggybacked on their ping, so steady-state
+        # workers converge on earned templates without an extra RPC
+        tseed = None
+        tversion = 0
+        if SP.value(self.session, "plan_template_enabled") and \
+                SP.value(self.session, "plan_template_seed_enabled"):
+            from ..cache import template_seeds
+
+            tversion = template_seeds().version
         for i, w in enumerate(self._worker_snapshot()):
             memory = metrics = None
+            req = {"op": "ping"}
+            ship = bool(tversion) and \
+                getattr(w, "template_seed_version", 0) < tversion
+            if ship:
+                if tseed is None:
+                    from ..cache import template_seeds
+
+                    tseed = template_seeds().export_seed()
+                if tseed["shapes"]:
+                    req["template_seed"] = tseed
+                else:
+                    ship = False
             try:
-                resp = w.rpc({"op": "ping"}, timeout=10)
+                resp = w.rpc(req, timeout=10)
                 alive = bool(resp.get("ok"))
                 memory = resp.get("memory")
                 metrics = resp.get("metrics")
+                if alive and ship:
+                    w.template_seed_version = tversion
             except OSError:
                 alive = False
             was_alive = w.alive

@@ -195,7 +195,18 @@ class WorkerServer:
 
                 seeded = stats_store.store().import_seed(
                     req["hbo_seed"])
-            send_msg(sock, {"ok": True, "hbo_seeded": seeded})
+            template_seeded = 0
+            if req.get("template_seed"):
+                # template-earn state rides the same transport (round
+                # 17): a replacement worker rides already-earned plan
+                # templates on its FIRST statement instead of
+                # re-earning min_shape_uses locally
+                from ..cache import template_seeds
+
+                template_seeded = template_seeds().import_seed(
+                    req["template_seed"])
+            send_msg(sock, {"ok": True, "hbo_seeded": seeded,
+                            "template_seeded": template_seeded})
         elif op == "run_task":
             send_msg(sock, self.run_task(req))
         elif op == "get_results":
@@ -241,9 +252,19 @@ class WorkerServer:
             # families must reuse it, never re-sample
             memory = self.node_pool.snapshot() \
                 if self.node_pool is not None else None
+            template_seeded = 0
+            if req.get("template_seed"):
+                # coordinator template-earn deltas piggyback on the
+                # heartbeat (round 17): steady-state workers converge
+                # on earned templates without an extra RPC
+                from ..cache import template_seeds
+
+                template_seeded = template_seeds().import_seed(
+                    req["template_seed"])
             send_msg(sock, {"ok": True, "pid": os.getpid(),
                             "tasks": len(self.tasks),
                             "memory": memory,
+                            "template_seeded": template_seeded,
                             "metrics": self.metrics_families(memory)})
         elif op == "shutdown":
             send_msg(sock, {"ok": True})

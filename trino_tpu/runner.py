@@ -163,6 +163,38 @@ class LocalQueryRunner:
             ctx.store.save(path)
         return summary
 
+    def _record_batched_hbo(self, ctx, shape, root, result, depth: int):
+        """History recording for a vmapped batch (round 17): the mask
+        popcounts ARE the per-lane operator actuals, so every real lane
+        records exactly what its serial execution would have — padding
+        lanes never record, and a spilled lane records on its serial
+        re-run instead (its batched masks are truncated)."""
+        from . import session_properties as SP
+
+        recorded = False
+        material = False
+        for lane in range(depth):
+            if lane in result.spilled:
+                continue
+            actuals = [{"fp": sr["fp"], "name": sr["name"],
+                        "rows": float(sr["rows"][lane])}
+                       for sr in result.stage_rows if sr["fp"]]
+            if not actuals:
+                return
+            try:
+                summary = ctx.record_actuals(
+                    root, self.metadata, actuals,
+                    scan_rows=result.scan_rows)
+            except Exception:
+                return
+            recorded = True
+            material = material or bool(summary and summary["material"])
+        if material and shape is not None:
+            self.query_cache.plans.invalidate_shape(shape)
+        path = SP.value(self.session, "hbo_store_path")
+        if path and recorded:
+            ctx.store.save(path)
+
     def explain(self, sql: str) -> str:
         from .planner.optimizer import provenance_lines
 
@@ -269,6 +301,20 @@ class LocalQueryRunner:
             return None
         tc = self.query_cache.templates
         total_uses = tc.note_uses(pq.shape, uses)
+        seeds = None
+        shape_fp = None
+        if SP.value(self.session, "plan_template_seed_enabled"):
+            from .cache import template_seeds
+            from .telemetry.stats_store import statement_fingerprint
+
+            # cluster-wide earn state (round 17): a replacement worker
+            # whose coordinator seed carries this shape's use total
+            # rides the already-earned template on its FIRST statement
+            # instead of re-earning min_shape_uses locally
+            seeds = template_seeds()
+            shape_fp = statement_fingerprint(pq.shape)
+            total_uses = max(total_uses, seeds.uses(shape_fp))
+            seeds.note(shape_fp, total_uses)
         hit = tc.lookup(tkey)
         if hit is not None:
             kind, val = hit
@@ -284,9 +330,18 @@ class LocalQueryRunner:
                 and not hint:
             return None  # not yet earned: the build trial must amortize
         max_entries = SP.value(self.session, "plan_cache_entries")
+        if seeds is not None:
+            seeded_reason = seeds.fallback_reason(shape_fp)
+            if seeded_reason is not None:
+                # another node already proved the shape value-dependent:
+                # negative-cache locally without paying a trial plan
+                tc.store_fallback(tkey, seeded_reason, max_entries)
+                return None
         reason = self._template_ineligible_reason(pq.shape)
         if reason is not None:
             tc.store_fallback(tkey, reason, max_entries)
+            if seeds is not None:
+                seeds.note_fallback_shape(shape_fp, reason)
             return None
         from .cache import PlanTemplate, analyze_literal_tokens
         from .expr.compiler import param_raw
@@ -297,6 +352,8 @@ class LocalQueryRunner:
             ptypes = tuple(lit.type for lit in lits)
             if any(getattr(t, "is_pooled", False) for t in ptypes):
                 tc.store_fallback(tkey, "string_param", max_entries)
+                if seeds is not None:
+                    seeds.note_fallback_shape(shape_fp, "string_param")
                 return None
             with template_parameters(ptypes):
                 root = self.plan_statement(pq.shape, hbo=hbo_ctx)
@@ -316,6 +373,8 @@ class LocalQueryRunner:
             # AnalysisError / TypeError_ / NOT_SUPPORTED — planning or
             # compilation genuinely needs a literal value
             tc.store_fallback(tkey, "value_dependent", max_entries)
+            if seeds is not None:
+                seeds.note_fallback_shape(shape_fp, "value_dependent")
             return None
         template = PlanTemplate(root, ptypes,
                                 scan_refs=self._scan_refs(root))
@@ -518,15 +577,18 @@ class LocalQueryRunner:
                 [chunk[-1][0]] * (depth - B)
             # operator construction binds the first lane's values (the
             # serial-fallback contract); execute_batched drives the
-            # processors with the STACKED vectors instead
+            # processors with the STACKED vectors instead.  hbo tags
+            # the fresh operators with node fingerprints so the mask
+            # popcounts record per-lane actuals below.
             local = self._make_local_planner(
                 processor_cache=self.query_cache.processors,
+                hbo=hbo_ctx,
                 params={i: param_raw(t, chunk[0][0][i])
                         for i, t in enumerate(template.param_types)})
             try:
                 try:
                     plan = local.plan(template.root)
-                    pages_per = execute_batched(
+                    result = execute_batched(
                         plan, template.param_types, padded, B)
                 except BatchIneligible as e:
                     tc.note_fallback(e.reason)
@@ -541,11 +603,25 @@ class LocalQueryRunner:
                     continue
             finally:
                 local.memory_pool.close()
+            for reason in result.dispositions:
+                tc.note_disposition(reason)
             with self.query_cache._lock:
-                self.query_cache.batched_launches += B
+                self.query_cache.batched_launches += \
+                    B - len(result.spilled)
+                self.query_cache.batched_spills += len(result.spilled)
+            if hbo_ctx is not None:
+                self._record_batched_hbo(hbo_ctx, pq0.shape,
+                                         template.root, result, B)
             for lane_i, (values, positions, key) in enumerate(chunk):
+                if lane_i in result.spilled:
+                    # this lane overflowed a unified per-lane capacity
+                    # (join expansion or agg hash budget): it — and only
+                    # it — falls back to the serial loop, which still
+                    # rides the template serially
+                    tc.note_fallback("lane_overflow")
+                    continue
                 rows: List[tuple] = []
-                for p in pages_per[lane_i]:
+                for p in result.pages[lane_i]:
                     rows.extend(p.to_rows())
                 res = QueryResult(
                     plan.column_names, plan.output_types, rows,

@@ -67,6 +67,9 @@ batched_execution_enabled,
 batched_execution_max_depth,
 batched_execution_min_shape_uses,
 batched_execution_pad_rows_limit
+plan_template_seed_enabled                 runner.py,
+                                           parallel/process_runner.py
+                                           (workers: shipped dict)
 query_profiling_enabled                    runner.py,
                                            parallel/distributed.py,
                                            parallel/worker.py
@@ -434,6 +437,16 @@ register(SessionProperty(
     "shapes with recorded history (HBO statement hint) qualify "
     "immediately",
     lambda v: v >= 1))
+register(SessionProperty(
+    "plan_template_seed_enabled", "boolean", True,
+    "Distributed template-cache coherence (round 17): the "
+    "coordinator's per-shape earn totals and fallback verdicts "
+    "piggyback on worker configure() and the heartbeat, so a "
+    "replacement or steady-state worker rides an already-earned "
+    "template on its first statement instead of re-earning "
+    "batched_execution_min_shape_uses locally (and skips shapes the "
+    "cluster already proved value-dependent). No effect when "
+    "plan_template_enabled is off"))
 register(SessionProperty(
     "batched_execution_pad_rows_limit", "integer", 1_000_000,
     "HBO-informed padding policy: when the shape's recorded scan rows "
