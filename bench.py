@@ -284,8 +284,70 @@ def _memory_smoke() -> dict:
                    "queries": {"qa": {"reserved": 70, "peak": 70},
                                "qb": {"reserved": 30, "peak": 30}}})
     victim = mgr.maybe_kill()
+
+    # -- hybrid hash join under a shrinking budget --------------------
+    # q3-shaped join (no aggregation: the agg finish-merge transient
+    # has its own cliff and would mask the join's behavior) at 100% /
+    # 50% / 25% of its own unconstrained peak: graceful degradation
+    # means the engine trades throughput for residency — partition
+    # demotions GROW down the ladder, rows/s shrinks smoothly — and
+    # NOTHING is killed.  A MemoryExceededError at any rung is rc=5,
+    # the same failure class as a row mismatch.
+    jsql = ("select o_orderdate, o_shippriority, l_extendedprice "
+            "from orders o, lineitem l "
+            "where o.o_orderkey = l.l_orderkey "
+            "order by l_extendedprice desc, o_orderdate limit 10")
+
+    def jrun(cap=None):
+        s = Session(catalog="tpch", schema="micro")
+        s.properties["hbo_enabled"] = False
+        if cap is not None:
+            s.properties.update(query_max_memory_bytes=cap,
+                                spill_enabled=True,
+                                spill_to_disk_enabled=True)
+        r = LocalQueryRunner({"tpch": TpchConnector(page_rows=256)},
+                             s, desired_splits=8)
+        t = time.time()
+        res = r.execute(jsql)
+        return res, time.time() - t
+
+    jclean, _ = jrun()
+    peak = jclean.stats["memory"]["peak_bytes"]
+    jrun(peak)  # warm the capped/spill code paths off the clock
+    probe_rows = LocalQueryRunner(
+        {"tpch": TpchConnector(page_rows=256)},
+        Session(catalog="tpch", schema="micro")).execute(
+            "select count(*) from lineitem").rows[0][0]
+    levels, kills, jok = {}, 0, True
+    for pct in (100, 50, 25):
+        cap = max(1, peak * pct // 100)
+        try:
+            res, wall = jrun(cap)
+        except Exception:
+            kills += 1
+            jok = False
+            levels[str(pct)] = {"cap_bytes": cap, "killed": True}
+            continue
+        m = res.stats["memory"]
+        levels[str(pct)] = {
+            "cap_bytes": cap,
+            "rows_s": round(probe_rows / max(wall, 1e-9), 1),
+            "partition_spills": m.get("partition_spills", 0),
+            "spill_events": m.get("spill_events", 0),
+        }
+        jok = jok and res.rows == jclean.rows
+    slope = None
+    if jok and kills == 0:
+        # the smallest budget must still run PARTITIONED (the matrix's
+        # bottom row), not complete by luck of a roomy plan
+        jok = levels["25"]["partition_spills"] > 0
+        slope = round(levels["25"]["rows_s"]
+                      / max(levels["100"]["rows_s"], 1e-9), 3)
     out = {
-        "ok": spilled.rows == clean.rows and victim == "qa",
+        "ok": (spilled.rows == clean.rows and victim == "qa"
+               and jok and kills == 0),
+        "hybrid_join": {"peak_bytes": peak, "levels": levels,
+                        "rows_s_slope": slope, "kills": kills},
         "spill_events": mem.get("spill_events", 0),
         "spilled_bytes": mem.get("spilled_bytes", 0),
         "disk_spill_events": mem.get("disk_spill_events", 0),

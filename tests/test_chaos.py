@@ -510,6 +510,54 @@ def test_low_memory_killer_kills_policy_victim(barrier_cluster):
     assert rec.kills[0].policy == "total-reservation-on-blocked-nodes"
 
 
+def test_barrier_driver_observes_abort_at_page_boundaries():
+    """The kill path's lever INSIDE a worker: a barrier (non-streaming)
+    task polls its abort flag at every page-move quantum, so an
+    abort_task broadcast (low-memory kill, superseded attempt) stops
+    the driver mid-execution — not after it drained its pipeline."""
+    from trino_tpu.parallel.fault import RemoteTaskError
+    from trino_tpu.parallel.remote_exchange import run_barrier_driver
+
+    class Driver:
+        def __init__(self, finish_at=None, abort=None, abort_at=None):
+            self.quanta = 0
+            self._finish_at = finish_at
+            self._abort = abort
+            self._abort_at = abort_at
+
+        def process(self):
+            self.quanta += 1
+            if self._abort_at == self.quanta:
+                self._abort.set()
+            return self._finish_at == self.quanta
+
+    # pre-set abort: not a single page moves
+    d = Driver()
+    with pytest.raises(RemoteTaskError):
+        run_barrier_driver(d, _set_event())
+    assert d.quanta == 0
+    # abort lands mid-run: observed at the NEXT page boundary
+    ev = threading.Event()
+    d = Driver(abort=ev, abort_at=7)
+    with pytest.raises(RemoteTaskError):
+        run_barrier_driver(d, ev)
+    assert d.quanta == 7
+    # flag never set: the driver runs to completion untouched
+    d = Driver(finish_at=3)
+    run_barrier_driver(d, threading.Event())
+    assert d.quanta == 3
+    # a driver that can NEVER finish still terminates (stuck-pipeline
+    # bound), it does not spin the worker thread forever
+    with pytest.raises(RemoteTaskError):
+        run_barrier_driver(Driver(), threading.Event(), max_quanta=100)
+
+
+def _set_event():
+    ev = threading.Event()
+    ev.set()
+    return ev
+
+
 def test_heartbeat_piggybacks_pool_snapshots(barrier_cluster):
     """Stats parity: what the ClusterMemoryManager aggregated from the
     heartbeat must equal what the workers report when asked directly."""
@@ -537,3 +585,79 @@ def test_heartbeat_piggybacks_pool_snapshots(barrier_cluster):
     res = c.execute("explain analyze " + Q1)
     text = "\n".join(r[0] for r in res.rows)
     assert "Cluster memory:" in text
+
+
+# ------------------------------------------------- hybrid join chaos ----
+
+
+def _spill_records():
+    """Hybrid-join spill records currently in the coordinator's HBO
+    store (worker demotions ride task responses into it — the witness
+    that a fault actually demoted build partitions, not just fired)."""
+    from trino_tpu.telemetry import stats_store
+
+    st = stats_store.store()
+    with st._lock:
+        return [h.spill for s in st._stmts.values()
+                for h in s["nodes"].values() if h.spill is not None]
+
+
+def test_revoke_memory_mid_build_hybrid_join(barrier_cluster):
+    """A seeded revoke-memory fault forces a full pool revocation
+    early in the join stage (mid-BUILD): the builder enters
+    partitioned mode and demotes partitions in place — the query
+    completes byte-equal with ZERO retries of any kind."""
+    c = barrier_cluster
+    _await_capacity(c)
+    clean = c.execute(Q3).rows
+    before = len(_spill_records())
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.", "revoke-memory", times=16,
+                         countdown=2)
+    res = c.execute(Q3)
+    assert res.rows == clean
+    rec = res.stats["recovery"]
+    assert rec["query_retries"] == 0, rec
+    assert rec["task_retries"] == 0, rec
+    assert len(_spill_records()) > before, \
+        "no partition demotion reached the coordinator's history store"
+
+
+def test_revoke_memory_mid_probe_hybrid_join(barrier_cluster):
+    """Same fault armed DEEP into the task (mid-PROBE / downstream):
+    cold probe rows park beside their build partition and replay in
+    the deferred per-partition passes — still byte-equal, still zero
+    retries."""
+    c = barrier_cluster
+    _await_capacity(c)
+    clean = c.execute(Q3).rows
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.", "revoke-memory", times=16,
+                         countdown=24)
+    res = c.execute(Q3)
+    assert res.rows == clean
+    rec = res.stats["recovery"]
+    assert rec["query_retries"] == 0, rec
+    assert rec["task_retries"] == 0, rec
+
+
+def test_kill_worker_during_partitioned_spill_join(task_cluster):
+    """kill-worker lands while the join stage is running partitioned
+    (a revoke-memory fault demoted build partitions first): TASK
+    policy recovers inside attempt 0 and the answer stays byte-equal
+    to the fault-free oracle."""
+    c = task_cluster
+    _await_capacity(c)
+    clean = getattr(c, "_q3_clean", None) or c.execute(Q3).rows
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.", "revoke-memory", times=16,
+                         countdown=2)
+    c.fault_schedule.add(f"{qid}.f1", "kill-worker")
+    mark = len(c.task_launches)
+    res = c.execute(Q3)
+    assert res.rows == clean
+    launches = _launches_since(c, mark)
+    assert all("a0." in t for t in launches), launches
+    rec = res.stats["recovery"]
+    assert rec["query_retries"] == 0
+    _await_capacity(c)

@@ -640,3 +640,207 @@ def test_local_explain_analyze_shows_disk_spill():
     res = r.execute("explain analyze " + AGG_SQL)
     text = "\n".join(row[0] for row in res.rows)
     assert "disk" in text and "spills" in text
+
+
+# ------------------------------------------------- hybrid hash join ----
+
+#: no aggregation above the join: the hybrid acceptance bar is about
+#: the JOIN surviving a pool far smaller than its build, not about
+#: the agg's own spill behaviour (and ORDER BY pins row order — cold
+#: partitions emit after the resident stream)
+HYBRID_SQL = ("select o_orderkey, o_orderpriority, l_quantity "
+              "from orders o, lineitem l "
+              "where o.o_orderkey = l.l_orderkey and l_quantity > 45 "
+              "order by o_orderkey, l_quantity limit 50")
+
+
+@pytest.fixture(scope="module")
+def hybrid_baseline():
+    return make_runner(hbo_enabled=False).execute(HYBRID_SQL).rows
+
+
+def test_hybrid_join_over_pool_completes_without_retry(hybrid_baseline):
+    """The tentpole acceptance bar: a join whose build + probe
+    transients exceed the pool several times over completes in ONE
+    attempt — partition demotions (partition_spills > 0) instead of a
+    MemoryExceededError/retry — and returns byte-equal rows."""
+    r = make_runner(query_max_memory_bytes=60_000, spill_enabled=True,
+                    spill_to_disk_enabled=True, spill_host_memory_bytes=0,
+                    hbo_enabled=False)
+    res = r.execute(HYBRID_SQL)
+    mem = res.stats["memory"]
+    assert mem["partition_spills"] > 0, mem
+    assert mem["partition_spilled_bytes"] > 0
+    assert mem["peak_bytes"] <= 60_000
+    assert res.rows == hybrid_baseline
+
+
+def test_hybrid_disabled_property_restores_wholesale_spill(
+        hybrid_baseline):
+    """hybrid_join_enabled=false falls back to the wholesale park-
+    everything path: still byte-equal, zero partition demotions."""
+    r = make_runner(query_max_memory_bytes=150_000, spill_enabled=True,
+                    spill_to_disk_enabled=True, spill_host_memory_bytes=0,
+                    hbo_enabled=False, hybrid_join_enabled=False)
+    res = r.execute(HYBRID_SQL)
+    assert res.stats["memory"]["partition_spills"] == 0
+    assert res.rows == hybrid_baseline
+
+
+def test_hybrid_second_run_sizes_fanout_from_hbo(hybrid_baseline):
+    """First constrained run records its spill record into the HBO
+    store; the SECOND run's builder sizes fan-out from it
+    (source=hbo) before any revocation pressure."""
+    from trino_tpu.ops import join as J
+
+    states = []
+    orig = J.HashBuilderOperator._init_partitions
+
+    def spy(self):
+        orig(self)
+        states.append(self._hstate)
+
+    J.HashBuilderOperator._init_partitions = spy
+    try:
+        r = make_runner(query_max_memory_bytes=60_000,
+                        spill_enabled=True, spill_to_disk_enabled=True,
+                        spill_host_memory_bytes=0)
+        res1 = r.execute(HYBRID_SQL)
+        assert res1.rows == hybrid_baseline
+        assert states and states[-1].source == "local"
+        first_fanout = states[-1].fanout
+        res2 = r.execute(HYBRID_SQL)
+        assert res2.rows == hybrid_baseline
+        assert states[-1].source == "hbo", \
+            "second run did not consume the HBO spill record"
+        assert states[-1].fanout >= first_fanout
+    finally:
+        J.HashBuilderOperator._init_partitions = orig
+
+
+def _skewed_join_page(fanout: int, heavy_pid_rows: int,
+                      light_pid_rows: int):
+    """One bigint key page whose rows are HEAVILY skewed onto a single
+    partition of ``fanout`` (returns the page and the heavy pid)."""
+    import jax.numpy as jnp
+
+    from trino_tpu import types as T
+    from trino_tpu.block import DevicePage
+    from trino_tpu.ops import join as J
+
+    hs = J.HybridJoinState(fanout)
+    keys = np.arange(16384, dtype=np.int64)
+    pids = hs.partition_ids([keys], [np.zeros(keys.size, bool)],
+                            [T.BIGINT], [None])
+    heavy = int(np.bincount(pids, minlength=fanout).argmax())
+    picked = [keys[pids == heavy][:heavy_pid_rows]]
+    for pid in range(fanout):
+        if pid != heavy:
+            picked.append(keys[pids == pid][:light_pid_rows])
+    col = np.concatenate(picked)
+    page = DevicePage([T.BIGINT], [jnp.asarray(col)],
+                      [jnp.zeros(col.size, dtype=bool)],
+                      jnp.ones(col.size, dtype=bool), [None])
+    return page, heavy
+
+
+def test_hybrid_mid_build_revocation_demotes_largest_in_place():
+    """Satellite unit: one revocation demotes exactly the LARGEST
+    resident partition — the rest of the build stays on device (in
+    place), the pool counts one partition spill."""
+    from trino_tpu import types as T
+    from trino_tpu.block import DevicePage
+    from trino_tpu.ops import join as J
+
+    pool = QueryMemoryPool(1 << 20, spill_enabled=True)
+    ctx = pool.create_context("build")
+    bridge = J.JoinBridge()
+    op = J.HashBuilderOperator(
+        [T.BIGINT], [0], bridge, memory_context=ctx,
+        hybrid={"fanout": 4, "max_depth": 3, "hint": None})
+    page, heavy = _skewed_join_page(4, 1024, 16)
+    op.add_input(page)
+    with ctx.lock:
+        freed = op._revoke()
+    hs = bridge.hybrid
+    assert freed > 0
+    assert hs.demotions == 1
+    assert set(hs.spilled_build) == {heavy}, \
+        "demotion did not pick the largest resident partition"
+    assert hs.resident == frozenset(range(4)) - {heavy}
+    assert any(isinstance(p, DevicePage) for p in op._pages), \
+        "revocation spilled the whole build instead of one partition"
+    assert pool.stats()["partition_spills"] == 1
+    assert hs.spill_fraction() > 0.5  # the heavy partition dominated
+    ctx.close()
+    pool.close()
+
+
+def test_hybrid_recursive_repartition_depth_bound():
+    """Satellite unit: an oversized cold partition repartitions with a
+    depth-salted hash while depth < max_depth; AT the bound it must
+    reserve-or-raise instead of recursing forever."""
+    import jax.numpy as jnp
+
+    from trino_tpu import types as T
+    from trino_tpu.exec.memory import MemoryExceededError
+    from trino_tpu.ops import join as J
+
+    types_ = [T.BIGINT]
+    cols = [jnp.arange(64, dtype=jnp.int64)]
+    nulls = [jnp.zeros(64, dtype=bool)]
+    b = J._assemble_build_side(types_, [0], cols, nulls,
+                               jnp.ones(64, dtype=bool), 64, [None])
+    bridge = J.JoinBridge()
+    bridge.set_build(b)
+    op = J.LookupJoinOperator(types_, [0], bridge, "inner")
+    op._ready = []
+    pool = QueryMemoryPool(64, spill_enabled=True)  # nothing fits
+    ctx = pool.create_context("build")
+    hs = J.HybridJoinState(4, max_depth=2)
+    hs.ctx = ctx
+    keys = np.arange(4096, dtype=np.int64)
+    sp = J._host_spilled(types_, [keys], [np.zeros(keys.size, bool)],
+                         keys.size, [None])
+    spp = J._host_spilled(types_, [keys[:128]],
+                          [np.zeros(128, bool)], 128, [None])
+    # below the bound: splits into depth-1 children at the queue FRONT
+    op._deferred = [{"depth": 0, "build": [sp], "probe": [spp]}]
+    op._advance_deferred(hs)
+    assert hs.repartitions == 1
+    assert op._deferred and all(e["depth"] == 1 for e in op._deferred)
+    child_rows = sum(int(np.asarray(p.valid).sum())
+                     for e in op._deferred for p in e["build"])
+    assert child_rows == keys.size  # no rows lost across the split
+    # the depth-salted hash actually redistributed the partition
+    assert len(op._deferred) > 1
+    # AT the bound: no further recursion — the reserve failure surfaces
+    op._deferred = [{"depth": 2, "build": [sp], "probe": [spp]}]
+    with pytest.raises(MemoryExceededError):
+        op._advance_deferred(hs)
+    assert hs.repartitions == 1  # did not split past max_depth
+    assert hs.max_depth_seen == 1
+    ctx.close()
+    pool.close()
+
+
+def test_hybrid_spill_record_hbo_roundtrip():
+    """Satellite unit: the spill record survives NodeHistory serde and
+    EWMA merges verbatim (it is replaced, never averaged)."""
+    from trino_tpu.telemetry.stats_store import NodeHistory
+
+    rec = {"fanout": 16, "source": "local", "fraction": 0.25,
+           "partitions_spilled": 3, "demotions": 3, "repartitions": 0,
+           "max_depth": 0}
+    h = NodeHistory("fp0", "JoinNode")
+    h.merge({"rows": 100.0, "spill": rec}, alpha=0.3)
+    assert h.spill == rec
+    # a later run WITHOUT spill keeps the last observed record
+    h.merge({"rows": 120.0}, alpha=0.3)
+    assert h.spill == rec
+    # a later run with a new record replaces it outright
+    rec2 = dict(rec, fanout=32, fraction=0.5)
+    h.merge({"rows": 90.0, "spill": rec2}, alpha=0.3)
+    assert h.spill == rec2
+    back = NodeHistory.from_dict(h.to_dict())
+    assert back.spill == rec2 and back.runs == 3

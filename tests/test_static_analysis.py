@@ -1905,6 +1905,42 @@ def test_guarded_by_not_blind_on_the_real_repo(repo_findings):
         .endswith("HostSpillLedger._lock")
 
 
+def test_guarded_by_sees_hybrid_join_partition_table(repo_findings):
+    """The hybrid hash join's partition table mutates from whatever
+    thread happens to hit the pool's revocation callback mid-reserve —
+    exactly the shape the guarded-by pass exists for.  Reachability
+    cannot see through the ``ctx._revoke_cb`` indirection, so the
+    single-entry exemption (not a resolved guard) is the expected
+    steady state; the floor pins what the pass DOES see: the class is
+    indexed, and every post-init access of the partition-table family
+    lexically holds ``HybridJoinState._lock``.  If the callback edge
+    ever becomes visible, the exemption must flip to the real guard,
+    never to a blind spot."""
+    from trino_tpu.analysis.guarded_by import analyze
+
+    index, _ = repo_findings
+    analysis = analyze(index)
+    base = "trino_tpu.ops.join.HybridJoinState."
+    lock = base + "_lock"
+    for attr in ("resident", "spilled_build", "spilled_probe",
+                 "spilled_build_rows", "total_build_rows",
+                 "demotions", "repartitions", "max_depth_seen"):
+        ss = analysis.sites.get(base + attr)
+        assert ss, f"guarded-by pass is blind to {base + attr}"
+        post = [s for s in ss if not s.in_init]
+        assert post, f"{attr}: no post-init sites indexed"
+        for s in post:
+            assert lock in s.lexical, (
+                f"{attr} touched outside the partition-table lock at "
+                f"{s.func_id}:{s.line}")
+        guard = analysis.guards.get(base + attr)
+        if guard is None:
+            assert analysis.exempt.get(base + attr) == "single-entry", \
+                (attr, analysis.exempt.get(base + attr))
+        else:
+            assert guard == lock, (attr, guard)
+
+
 def test_nine_passes_registered():
     assert sorted(PASSES) == sorted([
         "trace-purity", "lock-order", "recompile", "session-props",

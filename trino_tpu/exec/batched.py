@@ -635,6 +635,15 @@ def execute_batched(plan, param_types, bindings: Sequence[Tuple],
     def apply_join(k: int, op, page: _BatchPage) -> _BatchPage:
         b = op.bridge.build
         assert b is not None, "probe started before build finished"
+        hs = getattr(op.bridge, "hybrid", None)
+        if hs is not None and hs.spilled_build:
+            # the vmapped probe only sees the resident index; a build
+            # that went hybrid under memory pressure must not silently
+            # drop its cold partitions — fail the batch loudly (the
+            # caller re-runs lanes serially on lane_overflow fallbacks,
+            # and batched templates never run memory-governed anyway)
+            raise RuntimeError(
+                "batched probe over a hybrid-spilled build")
         kc = tuple(op.probe_keys)
         pooled = tuple(op.probe_types[c].is_pooled for c in kc)
         key_types = tuple(T.BIGINT if p else op.probe_types[c]

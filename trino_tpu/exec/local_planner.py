@@ -71,6 +71,11 @@ def grouping_options(props: Dict) -> Dict:
             props, "adaptive_partial_aggregation_key_range_buckets"),
         "matmul_max_key_range": SP.prop_value(
             props, "matmul_join_max_key_range"),
+        "hybrid_join": SP.prop_value(props, "hybrid_join_enabled"),
+        "hybrid_join_fanout": SP.prop_value(
+            props, "hybrid_join_fanout"),
+        "hybrid_join_max_depth": SP.prop_value(
+            props, "hybrid_join_max_depth"),
     }
 
 
@@ -133,6 +138,9 @@ class LocalExecutionPlanner:
                  adaptive_partial_min_rows: int = ADAPTIVE_MIN_ROWS,
                  adaptive_partial_buckets: int = ADAPTIVE_KEY_BUCKETS,
                  matmul_max_key_range: int = 1024,
+                 hybrid_join: bool = True,
+                 hybrid_join_fanout: int = 0,
+                 hybrid_join_max_depth: int = 3,
                  processor_cache=None, progress=None, hbo=None,
                  params=None):
         self.metadata = metadata
@@ -157,6 +165,11 @@ class LocalExecutionPlanner:
         #: encode (``matmul_join_max_key_range``) — the operator's
         #: runtime re-check of the cost model's range estimate
         self.matmul_max_key_range = matmul_max_key_range
+        #: dynamic hybrid hash join knobs (``hybrid_join_*`` session
+        #: properties): graceful build degradation under memory pressure
+        self.hybrid_join = hybrid_join
+        self.hybrid_join_fanout = hybrid_join_fanout
+        self.hybrid_join_max_depth = hybrid_join_max_depth
         #: override for write sinks: ``factory(TableWriterNode) -> sink``
         #: — the multi-process runtime routes worker writes to the
         #: coordinator's catalog through this (page-sink RPC)
@@ -329,18 +342,37 @@ class LocalExecutionPlanner:
     def _v_JoinNode(self, node: JoinNode):
         return self._plan_join(node.join_type, node.left, node.right,
                                node.criteria, node.filter_expr,
-                               node.strategy, node.strategy_detail)
+                               node.strategy, node.strategy_detail,
+                               node=node)
 
     def _v_CrossJoinNode(self, node: CrossJoinNode):
         # const-key equi join (build side replicated once)
         return self._plan_join("inner", node.left, node.right, [],
                                None)
 
+    def _hybrid_opts(self, join_type: str, node=None) -> Optional[Dict]:
+        """HashBuilderOperator ``hybrid`` options, or None when hybrid
+        degradation is off.  FULL OUTER stays wholesale: its unmatched-
+        build tail needs the complete index in one piece.  The hint is
+        the HBO spill record of this node's previous run — the stamped
+        ``hybrid_hint`` when the optimizer annotated one (multi-process
+        workers plan from shipped fragments and re-read it here), else
+        a direct store lookup."""
+        if not self.hybrid_join or join_type == "full":
+            return None
+        hint = getattr(node, "hybrid_hint", None) if node is not None \
+            else None
+        if hint is None and node is not None and self.hbo is not None:
+            hint = self.hbo.spill_hint(self.hbo.fp(node))
+        return {"fanout": self.hybrid_join_fanout,
+                "max_depth": self.hybrid_join_max_depth,
+                "hint": hint}
+
     def _plan_join(self, join_type: str, left: PlanNode, right: PlanNode,
                    criteria: List[Tuple[Symbol, Symbol]],
                    filter_expr: Optional[RowExpression],
                    strategy: str = "sorted-index",
-                   strategy_detail: str = ""):
+                   strategy_detail: str = "", node=None):
         build_dfs = []
         if self.dynamic_filtering:
             from .dynamic_filter import plan_dynamic_filters
@@ -376,11 +408,18 @@ class LocalExecutionPlanner:
                 build_keys.append(blayout[rsym.name])
 
         bridge = JoinBridge()
-        bops.append(HashBuilderOperator(
+        builder = HashBuilderOperator(
             btypes, build_keys, bridge,
             memory_context=self._mem_ctx("join-build"),
             dynamic_filters=[(blayout[rs.name], df)
-                             for rs, df in build_dfs]))
+                             for rs, df in build_dfs],
+            hybrid=self._hybrid_opts(join_type, node))
+        if self.hbo is not None and node is not None:
+            # the builder shares the join node's fingerprint (its
+            # output_rows are 0, so the row actual is untouched); its
+            # hybrid_spill metric is what spill_hint() serves next run
+            builder._hbo_fp = self.hbo.fp(node)
+        bops.append(builder)
         self.pipelines.append(PhysicalPipeline(bops))
 
         filter_fn = None
@@ -660,7 +699,8 @@ class LocalExecutionPlanner:
         bridge = JoinBridge()
         bops.append(HashBuilderOperator(
             btypes, bchans, bridge,
-            memory_context=self._mem_ctx("setop-build")))
+            memory_context=self._mem_ctx("setop-build"),
+            hybrid=self._hybrid_opts(join_type)))
         self.pipelines.append(PhysicalPipeline(bops))
         pchans = [playout[s.name] for s in left.output_symbols]
         pops.append(LookupJoinOperator(

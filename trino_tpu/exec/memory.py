@@ -537,6 +537,15 @@ class QueryMemoryPool:
         self.peak_bytes = 0
         self.spill_events = 0
         self.spilled_bytes = 0
+        self.partition_spills = 0       # hybrid-join partitions demoted
+        self.partition_spilled_bytes = 0
+        #: chaos harness only (FaultSchedule kind="revoke-memory"): a
+        #: PERIOD of reserve calls — every `countdown`-th reservation
+        #: triggers one full-pressure revocation, so deterministic
+        #: revocation pressure lands mid-build AND mid-probe without
+        #: shrinking the pool
+        self.fault_revoke_countdown: Optional[int] = None
+        self._fault_revoke_left: Optional[int] = None
         self._lock = threading.Lock()
         self._contexts: List[OperatorMemoryContext] = []
         self.host_ledger = parent.host_ledger if parent is not None \
@@ -596,8 +605,27 @@ class QueryMemoryPool:
                     self._free_locked(ctx, nbytes, revocable)
                 raise
 
+    def _maybe_fault_revoke(self):
+        """Injected revocation (chaos harness): every `countdown`-th
+        reserve call revokes EVERYTHING revocable — the partial-
+        revocation paths (hybrid-join partition demotion) then run
+        under real concurrency at every phase of the query, not just
+        under real pressure."""
+        with self._lock:
+            period = self.fault_revoke_countdown
+            if period is None:
+                return
+            left = self._fault_revoke_left
+            left = period - 1 if left is None else left - 1
+            if left > 0:
+                self._fault_revoke_left = left
+                return
+            self._fault_revoke_left = period
+        self.revoke_up_to(self.max_bytes)
+
     def _reserve_local(self, ctx: OperatorMemoryContext, nbytes: int,
                        revocable: bool):
+        self._maybe_fault_revoke()
         # revoke-until-fit loop: a concurrent reserve may consume bytes
         # another round of revocation just freed, so the target is
         # re-derived under the lock each round and the request only
@@ -640,10 +668,18 @@ class QueryMemoryPool:
                 break
             if c.revocable <= 0:
                 continue
-            with c.lock:
-                cb = c._revoke_cb
-                freed = cb() if cb is not None else 0
-            if freed > 0:
+            # PARTIAL-REVOCATION CONTRACT: a callback may free only a
+            # SLICE of its revocable state per call (the hybrid hash
+            # join demotes one build partition at a time) — keep asking
+            # the same context until the target is met or it stops
+            # making progress.  Wholesale callbacks are compatible: the
+            # second call finds nothing left and returns 0.
+            while total_freed < needed:
+                with c.lock:
+                    cb = c._revoke_cb
+                    freed = cb() if cb is not None else 0
+                if freed <= 0:
+                    break
                 total_freed += freed
                 self.record_spill(freed)
                 self._free(c, freed, revocable=True)
@@ -681,6 +717,15 @@ class QueryMemoryPool:
             self.spill_events += 1
             self.spilled_bytes += freed
 
+    def record_partition_spill(self, freed: int, parts: int = 1):
+        """One hybrid-join build partition demoted off-device (the
+        graceful-degradation counter the acceptance bar reads: a
+        squeezed join shows partition_spills > 0 with query_retries
+        still 0)."""
+        with self._lock:
+            self.partition_spills += parts
+            self.partition_spilled_bytes += freed
+
     def close(self):
         """Release every context's residue and the disk spill directory
         (end of the query's life on this node)."""
@@ -703,6 +748,8 @@ class QueryMemoryPool:
             "max_bytes": self.max_bytes,
             "spill_events": self.spill_events,
             "spilled_bytes": self.spilled_bytes,
+            "partition_spills": self.partition_spills,
+            "partition_spilled_bytes": self.partition_spilled_bytes,
         }
         if self.disk_spiller is not None:
             out.update(self.disk_spiller.stats())

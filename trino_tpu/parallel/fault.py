@@ -330,6 +330,9 @@ FAULT_KINDS = (
     "delay",                # straggler: sleep before executing
     "fail-after-publish",   # task fails AFTER its spool output published
     "truncate-spool",       # corrupt the published spool file mid-frame
+    "revoke-memory",        # force a full pool revocation every
+    #                         `countdown` reservations: pressure lands
+    #                         mid-build AND mid-probe deterministically
 )
 
 
@@ -340,6 +343,8 @@ class FaultSpec:
     remaining: int = 1      # occurrences left to fire
     delay_s: float = 0.0    # for kind == "delay"
     error_code: str = "DIVISION_BY_ZERO"   # for kind == "user-error"
+    countdown: int = 1      # for kind == "revoke-memory": the period of
+    #                         reservations between forced revocations
     fired: int = 0
 
 
@@ -364,12 +369,13 @@ class FaultSchedule:
 
     def add(self, pattern: str, kind: str = "error", times: int = 1,
             delay_s: float = 0.0,
-            error_code: str = "DIVISION_BY_ZERO") -> "FaultSchedule":
+            error_code: str = "DIVISION_BY_ZERO",
+            countdown: int = 1) -> "FaultSchedule":
         if kind not in FAULT_KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; "
                              f"expected one of {FAULT_KINDS}")
         self.specs.append(FaultSpec(pattern, kind, times, delay_s,
-                                    error_code))
+                                    error_code, countdown))
         return self
 
     def match(self, task_id: str) -> Optional[dict]:
@@ -391,6 +397,8 @@ class FaultSchedule:
                             (0.9 + 0.2 * h)
                     if spec.kind == "user-error":
                         directive["error_code"] = spec.error_code
+                    if spec.kind == "revoke-memory":
+                        directive["countdown"] = spec.countdown
                     return directive
         return None
 

@@ -1774,6 +1774,22 @@ class ProcessQueryRunner:
                 self.cluster_memory.check_killed(qid)
             except TrinoError as e:
                 fatal.append(e)
+                # the victim's in-flight attempts must actually STOP:
+                # streaming tasks abort between frames, and barrier
+                # tasks observe the flag at their next page-move
+                # quantum (run_barrier_driver) — without the broadcast
+                # a killed query's tasks kept computing with their
+                # reservations pinned until they finished on their own
+                for t in range(ntasks):
+                    cur = current_attempt.get(t)
+                    if cur is None or done[t].is_set():
+                        continue
+                    try:
+                        call(cur[0].addr, {"op": "abort_task",
+                                           "task_id": cur[1]},
+                             timeout=5)
+                    except OSError:
+                        pass
                 # unblock run_one threads waiting on nothing; attempts
                 # in flight resolve as superseded once `closed` is set
                 for ev in done:

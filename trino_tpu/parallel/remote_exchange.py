@@ -364,6 +364,27 @@ def wait_tokens(tokens, timeout: float = 0.25):
     ev.wait(timeout)
 
 
+def run_barrier_driver(driver, abort: threading.Event,
+                       max_quanta: int = 1_000_000):
+    """Barrier (non-streaming) twin of ``run_driver_blocking``: observe
+    the task's abort flag at every page-move quantum.  Before this seam
+    a barrier task ran its whole fragment with ``run_to_completion`` —
+    the coordinator's low-memory killer could pick the query as victim
+    but the worker-side task kept computing (and kept its reservations
+    pinned) until it finished on its own; now the kill lands at the
+    next page boundary."""
+    from .fault import INTERNAL, RemoteTaskError
+
+    for _ in range(max_quanta):
+        if abort.is_set():
+            raise RemoteTaskError("task aborted", INTERNAL)
+        if driver.process():
+            return
+    raise RemoteTaskError(
+        f"driver did not finish within {max_quanta} quanta "
+        "(stuck pipeline?)", INTERNAL)
+
+
 def run_driver_blocking(driver, abort: threading.Event,
                         max_idle_s: float = 600.0):
     """Drive one pipeline to completion in a dedicated thread, parking

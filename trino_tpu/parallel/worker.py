@@ -673,11 +673,16 @@ class WorkerServer:
         from ..planner.logical_planner import Metadata
         from ..telemetry.tracing import NULL_TRACER, add_driver_spans
         from .remote_exchange import (RemoteExchangeChannel,
+                                      run_barrier_driver,
                                       run_driver_blocking)
         from .rpc import fetch_pages
 
         if tracer is None:
             tracer = NULL_TRACER
+        if (fault or {}).get("kind") == "revoke-memory" \
+                and memory_pool is not None:
+            memory_pool.fault_revoke_countdown = \
+                max(1, int(fault.get("countdown") or 1))
         frag = req["fragment"]
         upstream: Dict[int, dict] = req["upstream"]
         task_index = req["task_index"]
@@ -823,7 +828,7 @@ class WorkerServer:
                 if streaming:
                     run_driver_blocking(d, state.abort)
                 else:
-                    d.run_to_completion()
+                    run_barrier_driver(d, state.abort)
         for d in drivers:
             add_driver_spans(tracer, d, exec_span)
         if hbo_ctx is not None:

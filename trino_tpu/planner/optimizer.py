@@ -308,7 +308,8 @@ def _matmul_max_build_rows() -> int:
 
 
 def choose_join_strategy(node: "JoinNode", calc, override: str,
-                         max_range: int) -> Tuple[str, str]:
+                         max_range: int,
+                         will_spill: bool = False) -> Tuple[str, str]:
     """('sorted-index' | 'matmul', detail).  The matmul probe wins when
     the build key domain maps densely onto a small one-hot width: one
     integer-ish (or dictionary-coded) equi key whose estimated range —
@@ -316,7 +317,13 @@ def choose_join_strategy(node: "JoinNode", calc, override: str,
     ``max_range``, over a confidently-small build.  Everything else
     keeps the sorted-index probe.  The operator re-checks the ACTUAL
     range at build time and falls back, so a forced 'MATMUL' override
-    is safe on any join."""
+    is safe on any join.
+
+    ``will_spill`` is the HBO-fed memory-pressure input: this node's
+    build spilled partitions on its last run, so a denser encoding
+    that avoids materializing the sorted index is worth 4x the normal
+    one-hot width (the matmul table is O(key range), not O(build
+    rows) — it sidesteps the partition machinery entirely)."""
     if override == "SORTED_INDEX":
         return "sorted-index", "forced by join_strategy"
     if override == "MATMUL":
@@ -324,6 +331,8 @@ def choose_join_strategy(node: "JoinNode", calc, override: str,
     if node.join_type not in ("inner", "semi", "anti") \
             or len(node.criteria) != 1:
         return "sorted-index", ""
+    eff_range = max_range * (4 if will_spill else 1)
+    spill_note = ", build will spill (hbo)" if will_spill else ""
     right = calc.stats(node.right)
     if not right.confident or right.row_count > _matmul_max_build_rows():
         return "sorted-index", ""
@@ -332,11 +341,11 @@ def choose_join_strategy(node: "JoinNode", calc, override: str,
     t = r.type
     if getattr(t, "is_pooled", False):
         # dictionary codes ARE the dense domain; pool size ~ NDV
-        if rs.distinct_count is None or rs.distinct_count > max_range:
+        if rs.distinct_count is None or rs.distinct_count > eff_range:
             return "sorted-index", ""
         detail = (f"build~{right.row_count:.0f} rows, pool~"
-                  f"{rs.distinct_count:.0f} codes <= {max_range}, "
-                  f"source={right.source}")
+                  f"{rs.distinct_count:.0f} codes <= {eff_range}, "
+                  f"source={right.source}{spill_note}")
         return "matmul", detail
     storage = getattr(t, "storage", None)
     import numpy as _np
@@ -349,10 +358,11 @@ def choose_join_strategy(node: "JoinNode", calc, override: str,
         # on the sorted index
         return "sorted-index", ""
     key_range = rs.high - rs.low + 1
-    if key_range > max_range:
+    if key_range > eff_range:
         return "sorted-index", ""
     detail = (f"build~{right.row_count:.0f} rows, key range "
-              f"{key_range:.0f} <= {max_range}, source={right.source}")
+              f"{key_range:.0f} <= {eff_range}, "
+              f"source={right.source}{spill_note}")
     return "matmul", detail
 
 
@@ -419,11 +429,24 @@ def annotate_kernel_strategies(node: PlanNode, metadata: Metadata,
             st = calc.stats(n)
             n.est_rows, n.est_source = st.row_count, st.source
         if isinstance(n, JoinNode):
-            strat, detail = choose_join_strategy(n, calc, join_override,
-                                                 max_range)
+            spill_hint = hbo.spill_hint(hbo.fp(n)) \
+                if hbo is not None else None
+            strat, detail = choose_join_strategy(
+                n, calc, join_override, max_range,
+                will_spill=bool(spill_hint))
             n.strategy, n.strategy_detail = strat, detail
             if strat == "matmul":
                 trace.append(("MatmulJoinStrategy", detail))
+            if spill_hint is not None:
+                # plain attribute (like est_rows): rides to the local
+                # planner without touching the node's fingerprint, so
+                # the second run sizes its partition fan-out from the
+                # first run's observed spill
+                n.hybrid_hint = dict(spill_hint)
+                trace.append(("HybridJoinFanout",
+                              f"fanout={spill_hint.get('fanout')} "
+                              f"fraction={spill_hint.get('fraction')} "
+                              f"source=hbo"))
         elif isinstance(n, AggregationNode) and n.group_keys:
             st = calc.stats(n)
             if not st.confident and agg_override == "AUTOMATIC":

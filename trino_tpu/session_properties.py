@@ -55,6 +55,9 @@ rebalance_min_collectives                  parallel/distributed.py,
 join_strategy, aggregation_strategy        planner/optimizer.py
 matmul_join_max_key_range                  planner/optimizer.py,
                                            exec/local_planner.py
+hybrid_join_enabled,                       exec/local_planner.py
+hybrid_join_fanout,                        (grouping_options)
+hybrid_join_max_depth
 global_hash_agg_max_table                  planner/optimizer.py
                                            (mesh runtime via
                                            choose_agg_strategy default)
@@ -358,6 +361,29 @@ register(SessionProperty(
     "build key range/pool size estimate fits (the measured low-NDV "
     "win region — BENCH_ROLE=kernels reports the crossover)",
     lambda v: v >= 2))
+register(SessionProperty(
+    "hybrid_join_enabled", "boolean", True,
+    "Dynamic hybrid hash join: a join build under memory pressure "
+    "partitions by a splitmix64 key sub-hash, keeps hot partitions "
+    "device-resident, parks cold partitions through the spill tiers, "
+    "and joins them in per-partition unspill->probe passes — the "
+    "pool's revocation demotes one partition at a time instead of "
+    "dumping the whole build (reference: 'Design Trade-offs for a "
+    "Robust Dynamic Hybrid Hash Join'). Off = wholesale build spill "
+    "(the pre-hybrid behavior); FULL OUTER joins always use it"))
+register(SessionProperty(
+    "hybrid_join_fanout", "integer", 0,
+    "Build partition count for the hybrid hash join (rounded to a "
+    "power of two, capped at 256). 0 = automatic: the HBO spill "
+    "record of the node's previous run, else pool headroom vs bytes "
+    "accumulated when pressure first hit",
+    lambda v: v >= 0))
+register(SessionProperty(
+    "hybrid_join_max_depth", "integer", 3,
+    "Recursion bound on repartitioning an unspilled partition that "
+    "still exceeds the pool (each level quarters it); at the bound "
+    "the partition joins anyway and may legitimately exceed the pool",
+    lambda v: v >= 1))
 register(SessionProperty(
     "aggregation_strategy", "varchar", "AUTOMATIC",
     "Distributed GROUP BY merge shape: AUTOMATIC (cost model picks "

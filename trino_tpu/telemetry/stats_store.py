@@ -123,6 +123,16 @@ def _canon_node(node) -> tuple:
         v = getattr(node, f.name)
         if f.name == "step" and isinstance(v, str):
             v = _CANON_STEP.get(v, v)
+        if f.name == "criteria" and isinstance(v, (list, tuple)):
+            # Join commutation (HBO actuals flipping which side is
+            # smaller) swaps every (probe, build) criteria pair; the
+            # commuted join is the same logical node, so order within
+            # a pair — and among pairs — must not move its history.
+            out.append((f.name, tuple(sorted(
+                tuple(sorted(_canon_value(s) for s in pair))
+                if isinstance(pair, (list, tuple)) else _canon_value(pair)
+                for pair in v))))
+            continue
         out.append((f.name, _canon_value(v)))
     return tuple(out)
 
@@ -167,6 +177,11 @@ class NodeHistory:
     #: node ({"verdict": ..., "pass_buckets": [...]}) — seeds the next
     #: run's operator past its observation window
     adaptive: Optional[dict] = None
+    #: hybrid-join spill record of a join-build node ({"fanout": ...,
+    #: "fraction": ..., "partitions_spilled": ...}) — the SECOND run
+    #: sizes its partition fan-out from it (source=hbo) and the
+    #: optimizer learns the build will spill
+    spill: Optional[dict] = None
 
     _EWMA_FIELDS = ("rows", "bytes", "wall_ms", "flops", "peak_bytes")
 
@@ -181,12 +196,15 @@ class NodeHistory:
                 setattr(self, k, (1.0 - alpha) * cur + alpha * v)
         if upd.get("adaptive") is not None:
             self.adaptive = upd["adaptive"]
+        if upd.get("spill") is not None:
+            self.spill = upd["spill"]
 
     def to_dict(self) -> dict:
         return {"fp": self.fp, "name": self.name, "rows": self.rows,
                 "bytes": self.bytes, "wall_ms": self.wall_ms,
                 "flops": self.flops, "peak_bytes": self.peak_bytes,
-                "runs": self.runs, "adaptive": self.adaptive}
+                "runs": self.runs, "adaptive": self.adaptive,
+                "spill": self.spill}
 
     @classmethod
     def from_dict(cls, d: dict) -> "NodeHistory":
@@ -195,7 +213,8 @@ class NodeHistory:
                    float(d.get("wall_ms", 0.0)),
                    float(d.get("flops", 0.0)),
                    float(d.get("peak_bytes", 0.0)),
-                   int(d.get("runs", 0)), d.get("adaptive"))
+                   int(d.get("runs", 0)), d.get("adaptive"),
+                   d.get("spill"))
 
 
 def _dump_statement(fp: str, st: dict) -> dict:
@@ -542,6 +561,8 @@ def merge_actuals(lists: Iterable[List[dict]]) -> List[dict]:
                     + float(a.get(k) or 0.0)
             if a.get("adaptive") is not None:
                 cur["adaptive"] = a["adaptive"]
+            if a.get("spill") is not None:
+                cur["spill"] = a["spill"]
     return list(by_fp.values())
 
 
@@ -609,6 +630,15 @@ class HboContext:
         h = self.store.lookup(self.stmt_fp, node_fp, self.snap)
         return h.adaptive if h is not None else None
 
+    def spill_hint(self, node_fp: str) -> Optional[dict]:
+        """The hybrid-join spill record of this node's previous run
+        (None = never observed spilling): feeds fan-out sizing
+        (source=hbo) and the optimizer's will-spill cost input."""
+        if self.store is None:
+            return None
+        h = self.store.lookup(self.stmt_fp, node_fp, self.snap)
+        return h.spill if h is not None else None
+
     def statement_hint(self) -> Optional[dict]:
         if self.store is None:
             return None
@@ -643,6 +673,10 @@ class HboContext:
                 if getattr(st, "metrics", None) else None
             if verdict is not None:
                 cur["adaptive"] = verdict
+            hspill = (st.metrics or {}).get("hybrid_spill") \
+                if getattr(st, "metrics", None) else None
+            if hspill is not None:
+                cur["spill"] = hspill
         return list(by_fp.values())
 
     def record(self, root, metadata, op_stats: Iterable,
