@@ -74,7 +74,13 @@ qps:<schema> is reported, not gated, being ~2x host-noisy);
 BENCH_ROLE=hbo (history-based-statistics report: tiny q1+q3 twice
 with recording, hbo_qerror_p50/p90 metric lines [ratchet-ready for
 the next baseline commit] + the lying-connector matmul-flip witness,
-HBO_RESULT line, rc=13 when the flip or byte-equality fails). The
+HBO_RESULT line, rc=13 when the flip or byte-equality fails);
+BENCH_ROLE=elastic (elastic-cluster smoke: a queue-depth burst of 12
+concurrent queries against a max_concurrency=2 resource group makes
+the autoscaler grow the membership 2 -> 4 mid-burst, the grown
+cluster places tasks on the joiners, idle drains back down to the
+floor with zero lost rows, ELASTIC_RESULT line carrying every
+autoscaler decision, rc=14 on a missed scale event or row loss). The
 parent runs the qlint static
 analyzer as a pre-flight before spawning any child (rc=8 on
 non-baselined findings: retrace-hazardous code must not burn the TPU
@@ -243,6 +249,114 @@ def _chaos_smoke(n_workers: int = 2, seed: int = 7) -> dict:
     print("CHAOS_RESULT " + json.dumps(out), flush=True)
     if not out["ok"]:
         raise SystemExit(4)
+    return out
+
+
+def _elastic_smoke() -> dict:
+    """BENCH_ROLE=elastic: elastic-cluster smoke — a queue-depth burst
+    (12 concurrent queries against a max_concurrency=2 resource group)
+    must make the autoscaler grow the membership 2 -> 4 mid-burst; the
+    grown cluster takes new tasks (width-4 plans place .t2/.t3); idle
+    then drains workers back down to the floor one at a time with zero
+    lost rows anywhere. Every decision the policy took is printed on
+    the ELASTIC_RESULT line. rc=14 on any violated invariant."""
+    _qlint_preflight()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from trino_tpu.parallel.process_runner import ProcessQueryRunner
+    from trino_tpu.resource_groups import ResourceGroupManager
+    from trino_tpu.sql.analyzer import Session
+
+    sql = ("select l_returnflag, l_linestatus, count(*), "
+           "sum(l_quantity) from lineitem "
+           "group by l_returnflag, l_linestatus")
+    rg = ResourceGroupManager.from_config({"groups": [
+        {"name": "global", "max_concurrency": 2,
+         "max_queued": 10_000}]})
+    s = Session(catalog="tpch", schema="micro")
+    s.properties.update({
+        "retry_policy": "QUERY",
+        "partial_stage_retry": True,
+        "autoscale_enabled": True,
+        "autoscale_min_workers": 2,
+        "autoscale_max_workers": 4,
+        "autoscale_cooldown_s": 0.5,
+        "autoscale_up_queue_depth": 1,
+        "autoscale_down_idle_ticks": 4,
+    })
+    failures: list = []
+    with ProcessQueryRunner(
+            {"tpch": {"connector": "tpch", "page_rows": 4096}}, s,
+            n_workers=2, desired_splits=4, heartbeat_interval=0.25,
+            resource_groups=rg) as c:
+        clean = sorted(c.execute(sql).rows)
+        lock = threading.Lock()
+        burst: list = []
+        # burst threads keep the queue pressed until the membership
+        # actually grows — worker spawn latency must not let the queue
+        # drain before the scale-up decision lands
+        grown = threading.Event()
+
+        def one():
+            for _ in range(40):
+                if grown.is_set():
+                    return
+                try:
+                    r = c.execute(sql)
+                    with lock:
+                        burst.append(
+                            (sorted(r.rows) == clean,
+                             r.stats["recovery"]["query_retries"]))
+                except Exception as e:
+                    with lock:
+                        failures.append(repr(e))
+                    return
+
+        t0 = time.time()
+        threads = [threading.Thread(target=one) for _ in range(12)]
+        for t in threads:
+            t.start()
+        peak = len(c.workers)
+        grow_deadline = time.time() + 90
+        while any(t.is_alive() for t in threads):
+            peak = max(peak, len(c.workers))
+            if peak >= 4 or time.time() > grow_deadline:
+                grown.set()
+            time.sleep(0.05)
+        for t in threads:
+            t.join()
+        burst_wall = time.time() - t0
+        # the grown membership must actually take new tasks: a query
+        # planned at the scaled width places .t2/.t3 on the joiners
+        mark = len(c.task_launches)
+        post = c.execute(sql)
+        post_ok = sorted(post.rows) == clean
+        wide = any(".t2" in t for t in c.task_launches[mark:])
+        # idle: drain-based scale-down back to the floor, one at a time
+        deadline = time.time() + 120
+        while time.time() < deadline and len(c.workers) > 2:
+            time.sleep(0.2)
+        final_ok = sorted(c.execute(sql).rows) == clean
+        snap = c.autoscaler.snapshot()
+        out = {
+            "ok": (not failures and len(burst) >= 4
+                   and all(eq for eq, _ in burst)
+                   and all(qr == 0 for _, qr in burst)
+                   and peak >= 4 and wide and post_ok and final_ok
+                   and len(c.workers) == 2
+                   and snap["scale_ups"] >= 1
+                   and snap["scale_downs"] >= 2),
+            "peak_workers": peak,
+            "final_workers": len(c.workers),
+            "burst_queries": len(burst),
+            "burst_wall_s": round(burst_wall, 2),
+            "burst_qps": round(len(burst) / max(burst_wall, 1e-9), 2),
+            "scaled_width_tasks": wide,
+            "decisions": snap["decisions"],
+            "failures": failures,
+        }
+    print("ELASTIC_RESULT " + json.dumps(out), flush=True)
+    if not out["ok"]:
+        raise SystemExit(14)
     return out
 
 
@@ -1613,6 +1727,8 @@ if __name__ == "__main__":
         _measure_child()
     elif os.environ.get("BENCH_ROLE") == "chaos":
         _chaos_smoke()
+    elif os.environ.get("BENCH_ROLE") == "elastic":
+        _elastic_smoke()
     elif os.environ.get("BENCH_ROLE") == "memory":
         _memory_smoke()
     elif os.environ.get("BENCH_ROLE") == "skew":

@@ -211,6 +211,33 @@ def test_bench_skew_smoke_child():
 
 
 @pytest.mark.slow
+def test_bench_elastic_smoke_child():
+    """The bench harness's elastic-cluster role (BENCH_ROLE=elastic):
+    a queue-depth burst against a max_concurrency=2 resource group
+    must make the autoscaler grow the membership 2 -> 4 mid-burst, the
+    grown cluster must place tasks on the joiners, and idle must drain
+    back down to the floor with zero lost rows and zero query retries
+    — run as the real child process so the membership/autoscaler paths
+    cannot rot outside the test suite."""
+    env = dict(os.environ, BENCH_ROLE="elastic", JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=560)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [line for line in proc.stdout.splitlines()
+             if line.startswith("ELASTIC_RESULT ")]
+    assert len(lines) == 1, proc.stdout[-2000:]
+    out = json.loads(lines[0][len("ELASTIC_RESULT "):])
+    assert out["ok"] is True
+    assert out["peak_workers"] >= 4
+    assert out["final_workers"] == 2
+    assert out["scaled_width_tasks"] is True
+    directions = [d["direction"] for d in out["decisions"]]
+    assert "up" in directions and directions.count("down") >= 2
+    assert out["failures"] == []
+
+
+@pytest.mark.slow
 def test_bench_kernels_smoke_child():
     """The bench harness's kernel-strategy role (BENCH_ROLE=kernels):
     the matmul join must byte-match the sorted-index oracle across the

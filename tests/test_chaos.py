@@ -661,3 +661,237 @@ def test_kill_worker_during_partitioned_spill_join(task_cluster):
     rec = res.stats["recovery"]
     assert rec["query_retries"] == 0
     _await_capacity(c)
+
+
+# ------------------------------- elastic cluster + partial-stage retry ----
+
+
+@pytest.fixture(scope="module")
+def elastic_cluster():
+    """partial_stage_retry over the default streaming shape: producers
+    retain their serialized frames (durable streams), tee pages into
+    the external spool backend, and consumers resolve lost producers
+    through the coordinator's resolve_task op — the elastic-cluster
+    fault model where task output outlives its worker."""
+    s = _mk_session(retry_policy="QUERY", partial_stage_retry=True)
+    with ProcessQueryRunner(CATALOGS, s, n_workers=2, desired_splits=4,
+                            broadcast_threshold=300.0,
+                            heartbeat_interval=0.25) as c:
+        c.fault_schedule = FaultSchedule(seed=42)
+        yield c
+
+
+def test_partial_retry_restarts_only_lost_tasks(local, elastic_cluster):
+    """THE acceptance scenario: a producer-task worker dies mid-stream
+    during a multi-stage streaming query. ONLY the lost tasks restart
+    (same wire ids, ``.r1`` markers), consumers resume from their ack
+    cursors, results stay byte-equal, and the query-retry counter stays
+    at ZERO — no wholesale re-execution."""
+    c = elastic_cluster
+    clean = sorted(c.execute(Q1).rows)
+    assert clean == sorted(local.execute(Q1).rows)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f1", "kill-worker")
+    mark = len(c.task_launches)
+    res = c.execute(Q1)
+    assert sorted(res.rows) == clean
+    rec = res.stats["recovery"]
+    assert rec["query_retries"] == 0, rec
+    launches = _launches_since(c, mark)
+    assert not any("a1." in t for t in launches), launches
+    assert any(".r1" in t for t in launches), launches
+    _await_capacity(c)
+    assert sorted(c.execute(Q1).rows) == clean
+
+
+def test_partial_retry_join_pipeline(elastic_cluster):
+    """Same fault against the join+TopN pipeline (4 fragments, merge
+    output): the resolve cascade repoints merge channels too, still
+    zero query retries, still byte-equal."""
+    c = elastic_cluster
+    _await_capacity(c)
+    clean = c.execute(Q3).rows
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f1", "kill-worker")
+    res = c.execute(Q3)
+    assert res.rows == clean
+    assert res.stats["recovery"]["query_retries"] == 0
+    _await_capacity(c)
+
+
+def test_scale_down_mid_query_streaming(elastic_cluster):
+    """retire_worker(drain=True) while a streaming query runs: the
+    slot drains (finishes its tasks) before the process dies, the
+    in-flight query loses nothing, and the shrunk cluster keeps
+    answering correctly."""
+    c = elastic_cluster
+    _await_capacity(c)
+    clean = sorted(c.execute(Q1).rows)
+    assert c.add_workers(1, reason="test-grow") == 1
+    results = {}
+
+    def run_q():
+        results["r"] = c.execute(Q1)
+
+    th = threading.Thread(target=run_q, daemon=True)
+    th.start()
+    time.sleep(0.05)
+    assert c.retire_worker(len(c.workers) - 1, drain=True, timeout=60)
+    th.join(timeout=60)
+    assert not th.is_alive()
+    assert sorted(results["r"].rows) == clean
+    assert results["r"].stats["recovery"]["query_retries"] == 0
+    assert len(c.workers) == 2
+    assert sorted(c.execute(Q1).rows) == clean
+
+
+def test_scale_down_mid_query_barrier(elastic_cluster):
+    """Drain-based retire under the barrier shape: stage results on the
+    draining worker are pulled before it exits — loss-free, zero
+    retries of any kind."""
+    c = elastic_cluster
+    _await_capacity(c)
+    saved = dict(c.session.properties)
+    c.session.properties["streaming_execution"] = False
+    try:
+        clean = sorted(c.execute(Q1).rows)
+        assert c.add_workers(1, reason="test-grow") == 1
+        results = {}
+
+        def run_q():
+            results["r"] = c.execute(Q1)
+
+        th = threading.Thread(target=run_q, daemon=True)
+        th.start()
+        time.sleep(0.05)
+        assert c.retire_worker(len(c.workers) - 1, drain=True,
+                               timeout=60)
+        th.join(timeout=60)
+        assert not th.is_alive()
+    finally:
+        c.session.properties.clear()
+        c.session.properties.update(saved)
+    assert sorted(results["r"].rows) == clean
+    # a stage launch may race the retire onto the dying slot; the
+    # lost-worker seam absorbs it as a task retry — never a query retry
+    assert results["r"].stats["recovery"]["query_retries"] == 0
+    assert len(c.workers) == 2
+
+
+def test_membership_churn_races_heal(elastic_cluster):
+    """A worker dies the moment the membership is also growing: the
+    heal loop replaces the dead slot while add_workers registers a new
+    one — no lost slots, no double-registration, queries stay exact,
+    and the ledger recorded every transition."""
+    c = elastic_cluster
+    _await_capacity(c)
+    clean = sorted(c.execute(Q1).rows)
+    joined_before, retired_before = c.cluster.counts()
+    victim = c.workers[0]
+    victim.proc.kill()
+    assert c.add_workers(1, reason="churn") == 1
+    _await_capacity(c)
+    assert sorted(c.execute(Q1).rows) == clean
+    assert c.retire_worker(len(c.workers) - 1, drain=True, timeout=60)
+    assert len(c.workers) == 2
+    joined, retired = c.cluster.counts()
+    assert joined >= joined_before + 2   # churn join + heal replacement
+    assert retired >= retired_before + 2  # killed slot + drained retire
+    active = [n for n in c.cluster.snapshot() if n.state == "active"]
+    assert len(active) == len(c.workers)
+
+
+def test_kill_after_publish_served_from_spool(task_cluster):
+    """A worker dies right AFTER durably publishing a task's output:
+    the output outlives the process — the coordinator adopts the
+    published spool bytes instead of relaunching the task (zero
+    retries), and the dead slot heals in the background."""
+    c = task_cluster
+    _await_capacity(c)
+    clean = getattr(c, "_q1_clean", None) or sorted(c.execute(Q1).rows)
+    pids = sorted(w.proc.pid for w in c.workers)
+    qid = _next_qid(c)
+    c.fault_schedule.add(f"{qid}.f1", "kill-after-publish")
+    mark = len(c.task_launches)
+    res = c.execute(Q1)
+    assert sorted(res.rows) == clean
+    launches = _launches_since(c, mark)
+    assert not any(".r1" in t for t in launches
+                   if f"{qid}.f1." in t), launches
+    rec = res.stats["recovery"]
+    assert rec["task_retries"] == 0, rec
+    assert rec["query_retries"] == 0, rec
+    _await_capacity(c)
+    # the fault really killed a process: one slot healed to a new pid
+    assert sorted(w.proc.pid for w in c.workers) != pids
+
+
+def test_stream_spool_corruption_is_loud_and_typed():
+    """A corrupted committed spool object fails the reader with the
+    typed SpoolCorruption — short reads and checksum mismatches never
+    surface as silently-partial rows."""
+    import os
+
+    from trino_tpu import types as T
+    from trino_tpu.block import Page
+    from trino_tpu.parallel.spool import SpoolCorruption
+    from trino_tpu.parallel.spool_backend import (
+        LocalFileSpoolBackend, SpooledTaskWriter, committed_attempt,
+        open_committed_partition, partition_key)
+
+    be = LocalFileSpoolBackend()
+    try:
+        w = SpooledTaskWriter(be, "qx", 0, 0, 0, 1)
+        w.add(0, Page.from_pylists([T.BIGINT, T.VARCHAR],
+                                   [[1, 2], ["a", "b"]]))
+        assert w.commit()
+        assert committed_attempt(be, "qx", 0, 0) == 0
+        path = os.path.join(be.base_dir,
+                            partition_key("qx", 0, 0, 0, 0))
+        with open(path, "r+b") as f:
+            f.truncate(os.path.getsize(path) - 3)
+        with pytest.raises(SpoolCorruption):
+            open_committed_partition(be, "qx", 0, 0, 0).pages()
+    finally:
+        be.remove_all()
+
+
+def test_sizing_seed_ships_to_joining_worker(elastic_cluster):
+    """Exchange-sizing knowledge crosses the membership boundary: a
+    joining worker is configured with the coordinator's merged sizing
+    history and acknowledges how many entries it imported."""
+    from trino_tpu.parallel.device_exchange import SIZING_HISTORY
+
+    c = elastic_cluster
+    _await_capacity(c)
+    SIZING_HISTORY.import_seed(
+        [[[["bigint"], "chaos-synthetic", 2, 4], 321.0, 3, None]])
+    assert c.add_workers(1, reason="seed-test") == 1
+    try:
+        assert c.workers[-1].sizing_seeded >= 1
+    finally:
+        assert c.retire_worker(len(c.workers) - 1, drain=True,
+                               timeout=60)
+    assert len(c.workers) == 2
+
+
+def test_system_runtime_nodes_reflects_ledger(elastic_cluster):
+    """system.runtime.nodes is the SQL view of the membership ledger:
+    one ACTIVE row per live slot, RETIRED rows for everything the
+    module churned through, generations monotonic."""
+    c = elastic_cluster
+    _await_capacity(c)
+    rows = c.execute(
+        "select node_id, address, state, pid, generation "
+        "from system.runtime.nodes").rows
+    active = [r for r in rows if r[2] == "ACTIVE"]
+    assert len(active) == len(c.workers)
+    live_pids = {w.proc.pid for w in c.workers}
+    assert {r[3] for r in active} == live_pids
+    assert any(r[2] == "RETIRED" for r in rows)
+    gens = [r[4] for r in rows]
+    assert gens == sorted(gens)
+    # elastic metrics families are registered alongside
+    fams = {f["name"] for f in c.metrics_families()}
+    assert {"trino_cluster_size", "trino_nodes_total",
+            "trino_autoscaler_target_workers"} <= fams

@@ -1,0 +1,124 @@
+"""Spool backend: object-store contract, CRC framing, atomic publish.
+
+Reference analog: ``FileSystemExchangeStorage`` under the exchange SPI —
+the storage half of fault-tolerant execution, where a task attempt's
+published output must be atomic, immutable, and checksum-verified.
+"""
+
+import os
+
+import pytest
+
+from trino_tpu import types as T
+from trino_tpu.block import Page
+from trino_tpu.parallel.spool import SpoolCorruption
+from trino_tpu.parallel.spool_backend import (
+    COMMIT_MARKER, BackendSpoolCursor, LocalFileSpoolBackend,
+    SpooledTaskWriter, attempt_key, committed_attempt, frame_blob,
+    open_committed_partition, partition_key, unframe_blob)
+
+
+def _page(i):
+    return Page.from_pylists([T.BIGINT, T.VARCHAR],
+                             [[i, i + 10], [f"s{i}", f"s{i + 10}"]])
+
+
+def test_object_roundtrip_and_first_publish_wins(tmp_path):
+    be = LocalFileSpoolBackend(str(tmp_path))
+    assert be.put("q1/f0/t0/a0/p0.bin", b"hello") is True
+    assert be.put("q1/f0/t0/a0/p0.bin", b"loser") is False
+    assert be.get("q1/f0/t0/a0/p0.bin") == b"hello"  # first wins
+    assert be.exists("q1/f0/t0/a0/p0.bin")
+    with pytest.raises(KeyError):
+        be.get("q1/f0/t0/a0/p9.bin")
+
+
+def test_list_and_delete_prefix(tmp_path):
+    be = LocalFileSpoolBackend(str(tmp_path))
+    be.put("q1/f0/t0/a0/p0.bin", b"x")
+    be.put("q1/f0/t0/a0/p1.bin", b"y")
+    be.put("q1/f1/t0/a0/p0.bin", b"z")
+    assert be.list("q1/f0/t0") == ["q1/f0/t0/a0/p0.bin",
+                                   "q1/f0/t0/a0/p1.bin"]
+    be.delete_prefix("q1/f0")
+    assert be.list("q1/f0/t0") == []
+    assert be.exists("q1/f1/t0/a0/p0.bin")  # sibling prefix untouched
+
+
+def test_crc_framing_detects_corruption():
+    frames = [b"frame-one", b"frame-two-longer"]
+    blob = frame_blob(frames)
+    assert unframe_blob(blob) == frames
+    # flip a payload bit: CRC must catch it, loudly and typed
+    torn = bytearray(blob)
+    torn[6] ^= 0x40
+    with pytest.raises(SpoolCorruption):
+        unframe_blob(bytes(torn))
+    # truncate mid-frame: torn read, same taxonomy
+    with pytest.raises(SpoolCorruption):
+        unframe_blob(blob[:-3])
+
+
+def test_task_writer_commit_marker_and_cursor(tmp_path):
+    be = LocalFileSpoolBackend(str(tmp_path))
+    w = SpooledTaskWriter(be, "q7", 1, 0, 0, n_partitions=2)
+    pages = [_page(i) for i in range(3)]
+    for p in pages:
+        w.add(0, p)
+    w.add(1, pages[0])
+    assert committed_attempt(be, "q7", 1, 0) is None  # not yet visible
+    assert w.commit() is True
+    assert committed_attempt(be, "q7", 1, 0) == 0
+    cur = open_committed_partition(be, "q7", 1, 0, 0)
+    assert [r for p in cur.pages() for r in p.to_rows()] == \
+        [r for p in pages for r in p.to_rows()]
+    # start_page resumes mid-stream: decoded but not re-yielded prefix
+    cur2 = open_committed_partition(be, "q7", 1, 0, 0, start_page=2)
+    assert [r for p in cur2.pages() for r in p.to_rows()] == \
+        pages[2].to_rows()
+
+
+def test_commit_race_lowest_attempt_wins(tmp_path):
+    be = LocalFileSpoolBackend(str(tmp_path))
+    for attempt in (1, 0):  # later attempt commits FIRST
+        w = SpooledTaskWriter(be, "q8", 0, 3, attempt, n_partitions=1)
+        w.add(0, _page(attempt))
+        assert w.commit() is True
+    # resolution is deterministic: every consumer adopts attempt 0
+    assert committed_attempt(be, "q8", 0, 3) == 0
+
+
+def test_aborted_writer_publishes_nothing(tmp_path):
+    be = LocalFileSpoolBackend(str(tmp_path))
+    w = SpooledTaskWriter(be, "q9", 0, 0, 0, n_partitions=1)
+    w.add(0, _page(1))
+    w.abort()
+    assert w.commit() is False
+    assert committed_attempt(be, "q9", 0, 0) is None
+    assert be.list("q9") == []
+
+
+def test_corrupt_partition_object_is_loud(tmp_path):
+    be = LocalFileSpoolBackend(str(tmp_path))
+    w = SpooledTaskWriter(be, "qa", 0, 0, 0, n_partitions=1)
+    w.add(0, _page(5))
+    w.commit()
+    key = partition_key("qa", 0, 0, 0, 0)
+    path = os.path.join(str(tmp_path), key)
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(size - 3)
+    with pytest.raises(SpoolCorruption):
+        BackendSpoolCursor(be, key).pages()
+
+
+def test_key_escape_rejected(tmp_path):
+    be = LocalFileSpoolBackend(str(tmp_path))
+    with pytest.raises(ValueError):
+        be.put("../escape", b"x")
+
+
+def test_commit_marker_key_shape():
+    assert attempt_key("q1", 2, 3, 1) == "q1/f2/t3/a1"
+    assert partition_key("q1", 2, 3, 1, 0) == "q1/f2/t3/a1/p0.bin"
+    assert COMMIT_MARKER == "COMMIT"

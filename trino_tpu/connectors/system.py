@@ -51,6 +51,11 @@ RUNTIME_TABLES = {
         ("task_id", T.VARCHAR), ("query_id", T.VARCHAR),
         ("worker", T.VARCHAR), ("state", T.VARCHAR),
         ("rows", T.BIGINT), ("error_type", T.VARCHAR)),
+    "nodes": (
+        ("node_id", T.VARCHAR), ("address", T.VARCHAR),
+        ("state", T.VARCHAR), ("pid", T.BIGINT),
+        ("generation", T.BIGINT), ("join_reason", T.VARCHAR),
+        ("retire_reason", T.VARCHAR)),
     "metrics": (
         ("name", T.VARCHAR), ("labels", T.VARCHAR),
         ("kind", T.VARCHAR), ("value", T.DOUBLE)),
@@ -96,9 +101,10 @@ class _SystemMetadata(ConnectorMetadata):
 
 class SystemConnector(Connector):
     """``source`` is the owning runner (duck-typed): ``event_manager``
-    backs the queries table, ``runtime_tasks()`` the tasks table, and
-    ``metrics_families()`` the metrics table; each is optional so any
-    runner can host the catalog."""
+    backs the queries table, ``runtime_tasks()`` the tasks table,
+    ``runtime_nodes()`` the nodes table (elastic membership ledger),
+    and ``metrics_families()`` the metrics table; each is optional so
+    any runner can host the catalog."""
 
     name = "system"
 
@@ -143,6 +149,8 @@ class SystemConnector(Connector):
                 return self._query_rows()
             if table == "tasks":
                 return self._task_rows()
+            if table == "nodes":
+                return self._node_rows()
             if table == "kernels":
                 return self._kernel_rows()
             if table == "plan_stats":
@@ -222,6 +230,10 @@ class SystemConnector(Connector):
 
     def _task_rows(self) -> List[tuple]:
         fn = getattr(self.source, "runtime_tasks", None)
+        return [tuple(r) for r in fn()] if callable(fn) else []
+
+    def _node_rows(self) -> List[tuple]:
+        fn = getattr(self.source, "runtime_nodes", None)
         return [tuple(r) for r in fn()] if callable(fn) else []
 
     def _metric_rows(self) -> List[tuple]:

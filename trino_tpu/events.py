@@ -68,6 +68,33 @@ class MemoryKillEvent:
 
 
 @dataclass(frozen=True)
+class NodeJoinedEvent:
+    """A worker process joined the cluster (initial spawn, heal
+    replacement, or elastic scale-up) — the membership half of the
+    self-healing/elasticity seam."""
+
+    node_id: str
+    worker_index: int
+    pid: int
+    generation: int                 # cluster generation at join
+    reason: str                     # initial | heal | scale-up | ...
+    time: float
+
+
+@dataclass(frozen=True)
+class NodeRetiredEvent:
+    """A worker process left the cluster (drain-based retire, autoscale
+    scale-down, or replacement of a dead worker)."""
+
+    node_id: str
+    pid: Optional[int]
+    generation: int                 # cluster generation after retire
+    reason: str                     # scale-down | replaced | ...
+    drained: bool                   # True when it drained gracefully
+    time: float
+
+
+@dataclass(frozen=True)
 class TaskRetryEvent:
     """A task or query attempt was retried (or speculatively
     re-dispatched) after a classified failure."""
@@ -90,6 +117,12 @@ class EventListener:
         pass
 
     def worker_replaced(self, event: WorkerReplacedEvent):
+        pass
+
+    def node_joined(self, event: NodeJoinedEvent):
+        pass
+
+    def node_retired(self, event: NodeRetiredEvent):
         pass
 
     def task_retry(self, event: TaskRetryEvent):
@@ -180,6 +213,20 @@ class EventListenerManager:
         for listener in self.listeners:
             try:
                 listener.worker_replaced(event)
+            except Exception:
+                pass
+
+    def fire_node_joined(self, event: NodeJoinedEvent):
+        for listener in self.listeners:
+            try:
+                listener.node_joined(event)
+            except Exception:
+                pass
+
+    def fire_node_retired(self, event: NodeRetiredEvent):
+        for listener in self.listeners:
+            try:
+                listener.node_retired(event)
             except Exception:
                 pass
 

@@ -158,6 +158,38 @@ class ExchangeSizingHistory:
                 rb = self._rebalancers[key] = factory()
             return rb
 
+    def export_seed(self) -> list:
+        """Serializable (key, ewma, obs, fractions) rows — the sizing
+        knowledge a heartbeat piggybacks coordinator-ward so a new or
+        replacement worker presizes exchanges from cluster history
+        instead of re-learning from scratch."""
+        with self._lock:
+            return [[list(k), self._ewma[k], self._obs.get(k, 0),
+                     self._fracs.get(k)] for k in self._ewma]
+
+    def import_seed(self, seed) -> int:
+        """Merge an exported seed, keeping the larger EWMA per shape
+        (grow-immediately mirrors ``observe``); idempotent, so repeated
+        heartbeat piggybacks are free. Returns rows merged."""
+        if not seed:
+            return 0
+        merged = 0
+        with self._lock:
+            for row in seed:
+                try:
+                    key = tuple(tuple(x) if isinstance(x, list) else x
+                                for x in row[0])
+                    ewma, obs, fracs = float(row[1]), int(row[2]), row[3]
+                except (TypeError, ValueError, IndexError):
+                    continue
+                if ewma >= self._ewma.get(key, 0.0):
+                    self._ewma[key] = ewma
+                    if fracs is not None:
+                        self._fracs[key] = list(fracs)
+                self._obs[key] = max(self._obs.get(key, 0), obs)
+                merged += 1
+        return merged
+
     def reset(self) -> None:
         with self._lock:
             self._ewma.clear()

@@ -329,6 +329,8 @@ FAULT_KINDS = (
     "drop-connection",      # close a results connection mid-frame
     "delay",                # straggler: sleep before executing
     "fail-after-publish",   # task fails AFTER its spool output published
+    "kill-after-publish",   # os._exit the worker AFTER spool publish:
+    #                         the output must outlive the process
     "truncate-spool",       # corrupt the published spool file mid-frame
     "revoke-memory",        # force a full pool revocation every
     #                         `countdown` reservations: pressure lands

@@ -76,7 +76,8 @@ from .. import session_properties as SP
 from .. import types as T
 from ..block import Page
 from ..events import (EventListenerManager, MemoryKillEvent,
-                      QueryMonitor, TaskRetryEvent, WorkerReplacedEvent)
+                      NodeJoinedEvent, NodeRetiredEvent, QueryMonitor,
+                      TaskRetryEvent, WorkerReplacedEvent)
 from ..exec.serde import PageDeserializer, PageSerializer
 from ..exec.stats import QueryStatsTree
 from ..planner.fragmenter import PlanFragment
@@ -88,6 +89,8 @@ from ..telemetry.metrics import ClusterMetrics
 from ..telemetry.tracing import (NULL_SPAN, NULL_TRACER, Tracer,
                                  add_driver_spans)
 from ..types import TrinoError
+from .autoscaler import Autoscaler
+from .cluster import ClusterLedger, place_task
 from .cluster_memory import ClusterMemoryManager
 from .fault import (EXTERNAL, INSUFFICIENT_RESOURCES, INTERNAL, USER,
                     BackoffPolicy, Deadline, DecayingFailureStats,
@@ -95,6 +98,8 @@ from .fault import (EXTERNAL, INSUFFICIENT_RESOURCES, INTERNAL, USER,
                     classify_error_code, classify_exception,
                     serialize_failure)
 from .rpc import call, fetch_pages, recv_msg, send_msg, with_trace
+from .spool_backend import (LocalFileSpoolBackend, backend_for,
+                            committed_attempt)
 
 
 class WorkerHandle:
@@ -118,6 +123,15 @@ class WorkerHandle:
         self.hbo_seeded = 0
         self.template_seeded = 0
         self.template_seed_version = 0
+        #: elastic-membership state: a draining worker finishes its
+        #: running tasks but takes no NEW placements; node_id /
+        #: member_generation tie the handle to its ledger record so a
+        #: straggling RPC against a retired slot is attributable
+        self.draining = False
+        self.node_id: Optional[str] = None
+        self.member_generation = 0
+        #: exchange-sizing seed rows the worker imported at configure
+        self.sizing_seeded = 0
 
     def rpc(self, request: dict, timeout: float = 600.0) -> dict:
         return call(self.addr, request, timeout=timeout)
@@ -173,6 +187,10 @@ class _QueryCtx:
         self.hbo_root = None
         self.hbo_actuals: List[list] = []
         self.hbo_lock = threading.Lock()
+        #: membership width CAPTURED once per attempt: an elastic
+        #: scale-up/down mid-query must not skew task fan-out against
+        #: the already-planned partition count
+        self.cluster_width: Optional[int] = None
 
     def timeout(self, base: Optional[float] = None) -> float:
         """RPC timeout capped by the query deadline (raises
@@ -230,7 +248,8 @@ class ProcessQueryRunner:
                  task_retries: int = 1,
                  heartbeat_interval: Optional[float] = 5.0,
                  worker_replacement: bool = True,
-                 event_listeners: Optional[list] = None):
+                 event_listeners: Optional[list] = None,
+                 resource_groups=None):
         from ..connectors.catalog import create_catalogs
         from ..planner.logical_planner import Metadata
 
@@ -299,6 +318,22 @@ class ProcessQueryRunner:
         self._heal_lock = threading.Lock()
         self._heal_done = threading.Condition(self._heal_lock)
         self._closed = threading.Event()
+        #: resource-group admission (a ResourceGroupManager or None =
+        #: unmanaged): execute() runs each statement under the user's
+        #: group, which makes queue depth a real autoscaling signal
+        self.resource_groups = resource_groups
+        #: membership event log + generation counter (the ledger behind
+        #: system.runtime.nodes; self.workers stays the placement view)
+        self.cluster = ClusterLedger()
+        #: deterministic scale-up/down policy, monitor-thread driven
+        self.autoscaler = Autoscaler()
+        #: durable stream-output store: under partial_stage_retry every
+        #: streaming task TEES its output pages here, so a task's
+        #: published output outlives its worker process
+        self.stream_spool = LocalFileSpoolBackend()
+        #: partial-retry registry: wire task_id -> relaunch state
+        self._stream_tasks: Dict[str, dict] = {}
+        self._stream_lock = threading.Lock()
         self.service = _CoordinatorService(self)
         self._spawn_workers()
         self._monitor_thread = None
@@ -309,7 +344,9 @@ class ProcessQueryRunner:
 
     # -- cluster lifecycle ----------------------------------------------
 
-    def _spawn_worker_process(self, generation: int = 0) -> WorkerHandle:
+    def _spawn_worker_process(self, generation: int = 0,
+                              reason: str = "initial",
+                              index: int = -1) -> WorkerHandle:
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    JAX_COMPILATION_CACHE_DIR="/tmp/trino_tpu_jax_cache")
         env.pop("XLA_FLAGS", None)  # workers need no virtual mesh
@@ -357,6 +394,14 @@ class ProcessQueryRunner:
             tseed = _tseeds().export_seed()
             if tseed["shapes"]:
                 cfg["template_seed"] = tseed
+        # exchange-sizing knowledge rides beside the HBO/template seeds:
+        # a joiner (scale-up OR replacement) presizes its device
+        # exchanges from cluster history instead of re-learning
+        from .device_exchange import SIZING_HISTORY
+
+        sseed = SIZING_HISTORY.export_seed()
+        if sseed:
+            cfg["sizing_seed"] = sseed
         resp = handle.rpc(cfg, timeout=60)
         #: statements the seed actually imported into the worker's
         #: store (observability: tests + replacement-worker freshness)
@@ -366,11 +411,137 @@ class ProcessQueryRunner:
         #: template-seed version last shipped to this worker — the
         #: heartbeat re-ships only when the local store has advanced
         handle.template_seed_version = _tseeds().version
+        handle.sizing_seeded = int(resp.get("sizing_seeded") or 0)
+        node = self.cluster.record_join(handle.addr, proc.pid,
+                                        reason=reason)
+        handle.node_id = node.node_id
+        handle.member_generation = node.generation
+        self.event_manager.fire_node_joined(NodeJoinedEvent(
+            node.node_id, index, proc.pid, node.generation, reason,
+            time.time()))
         return handle
 
     def _spawn_workers(self):
-        for _ in range(self.n_workers):
-            self.workers.append(self._spawn_worker_process())  # qlint: ignore[guarded-by] pre-publication: __init__ appends before the monitor thread exists
+        for i in range(self.n_workers):  # qlint: ignore[guarded-by] pre-publication: __init__ runs before the monitor thread exists
+            self.workers.append(self._spawn_worker_process(index=i))  # qlint: ignore[guarded-by] pre-publication: __init__ appends before the monitor thread exists
+
+    @staticmethod
+    def _placeable(workers: List[WorkerHandle]) -> List[WorkerHandle]:
+        """Live workers eligible for NEW task placement: a draining
+        worker finishes what it has but takes nothing new (falls back
+        to the full live set if everyone is draining)."""
+        live = [w for w in workers if w.alive]
+        active = [w for w in live if not w.draining]
+        return active or live
+
+    def add_workers(self, n: int, reason: str = "scale-up") -> int:
+        """Elastic scale-up: spawn + configure (catalogs, session, and
+        the HBO / template / sizing seeds — exactly the replacement
+        path), re-sync replicated tables, then PUBLISH the slot. The
+        slow work runs outside _heal_lock; only the append takes it.
+        Returns the number of workers that actually joined."""
+        added = 0
+        for _ in range(max(0, n)):
+            if self._closed.is_set():
+                break
+            with self._heal_lock:
+                next_index = len(self.workers)
+            try:
+                new = self._spawn_worker_process(
+                    reason=reason, index=next_index)
+                self._sync_worker_replicas(new)
+            except Exception as e:
+                print(f"[scale-up] worker join failed "
+                      f"({classify_exception(e)}): {e!r}",
+                      file=sys.stderr)
+                traceback.print_exc()
+                break
+            with self._heal_lock:
+                torn = self._closed.is_set()
+                if not torn:
+                    self.workers.append(new)
+                    self.n_workers = len(self.workers)
+            if torn:  # cluster closed mid-join: reap the orphan
+                try:
+                    new.proc.kill()
+                except OSError:
+                    pass
+                break
+            added += 1
+        return added
+
+    def retire_worker(self, slot: int, drain: bool = True,
+                      timeout: float = 60.0,
+                      reason: str = "scale-down") -> bool:
+        """Elastic scale-down: mark the slot draining (placement skips
+        it), wait for its running tasks to finish, then remove it from
+        the membership and reap the process. Refuses to retire the
+        last non-draining live worker. Returns True once it left."""
+        with self._heal_lock:
+            if not (0 <= slot < len(self.workers)):
+                return False
+            w = self.workers[slot]
+            others = [x for x in self.workers
+                      if x is not w and x.alive and not x.draining]
+            if not others:
+                return False
+            w.draining = True
+        if w.node_id is not None:
+            self.cluster.mark_draining(w.node_id)
+        drained = not drain
+        if drain and w.alive:
+            deadline = time.time() + timeout
+            while time.time() < deadline and not self._closed.is_set():
+                try:
+                    resp = w.rpc({"op": "ping"}, timeout=10)
+                except OSError:
+                    break  # already dead: nothing left to drain
+                if not resp.get("tasks"):
+                    drained = True
+                    break
+                time.sleep(0.1)
+        # wait out in-flight replacements before resizing the slot
+        # list: a concurrent _replace_worker swaps by index
+        self._await_heal_drain(
+            None, "[retire] in-flight worker replacement did not "
+                  "resolve within 300s; removing the slot anyway\n",
+            stop_on_close=True)
+        with self._heal_lock:
+            try:
+                idx = self.workers.index(w)
+            except ValueError:
+                return False  # concurrently removed (close/retire race)
+            del self.workers[idx]
+            self.n_workers = len(self.workers)
+            n_now = len(self.workers)
+        # index-keyed governance state shifted down past the removed
+        # slot: forget the tail, the next heartbeat tick repopulates
+        for i in range(idx, n_now + 1):
+            self.cluster_memory.forget_worker(i)
+            self.cluster_metrics.forget(i)
+        try:
+            w.rpc({"op": "shutdown"}, timeout=5)
+        except OSError:
+            pass
+        w.proc.terminate()
+        try:
+            w.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            w.proc.kill()
+        self._retire_node(w, reason, drained)
+        return True
+
+    def _retire_node(self, w: WorkerHandle, reason: str, drained: bool):
+        """Record a worker's departure in the ledger (generation bump)
+        and fire the membership event — shared by retire_worker and
+        the heal path's replacement of a dead worker."""
+        if w.node_id is None:
+            return
+        if self.cluster.record_retire(w.node_id, reason) is None:
+            return  # double-retire: already recorded
+        self.event_manager.fire_node_retired(NodeRetiredEvent(
+            w.node_id, w.proc.pid, self.cluster.generation, reason,
+            drained, time.time()))
 
     def _await_heal_drain(self, slots, note: str,
                           stop_on_close: bool = False):
@@ -430,6 +601,7 @@ class ProcessQueryRunner:
                     w.proc.kill()
             self.workers = []
         self.service.close()
+        self.stream_spool.remove_all()
 
     def __enter__(self):
         return self
@@ -466,6 +638,13 @@ class ProcessQueryRunner:
             create_table_idempotent(conn, req["schema"], req["table"],
                                     req["columns"])
             return {"ok": True}
+        if op == "resolve_task":
+            # a consumer lost its stream to a producer task: repoint /
+            # serve-from-spool / restart (partial-stage retry)
+            return {"ok": True,
+                    "resolution": self._resolve_lost_producer(
+                        req["task_id"], int(req.get("cursor") or 0),
+                        tuple(req["failed_addr"]))}
         return {"error": f"unknown coordinator op {op!r}"}
 
     def _sync_table(self, catalog: str, schema: str, table: str,
@@ -573,6 +752,14 @@ class ProcessQueryRunner:
                 metrics = resp.get("metrics")
                 if alive and ship:
                     w.template_seed_version = tversion
+                if alive and resp.get("sizing"):
+                    # exchange-sizing observations travel worker ->
+                    # coordinator on the heartbeat; configure ships the
+                    # merged seed to every joiner, so presize learning
+                    # survives membership churn
+                    from .device_exchange import SIZING_HISTORY
+
+                    SIZING_HISTORY.import_seed(resp.get("sizing"))
             except OSError:
                 alive = False
             was_alive = w.alive
@@ -658,7 +845,8 @@ class ProcessQueryRunner:
             return
         new = None
         try:
-            new = self._spawn_worker_process(old.generation + 1)
+            new = self._spawn_worker_process(old.generation + 1,
+                                             reason="heal", index=index)
             self._sync_worker_replicas(new)
         except Exception as e:
             # swallow deliberately (the next heal tick retries) but
@@ -698,6 +886,7 @@ class ProcessQueryRunner:
             self.recovery_total.incr("workers_replaced")
         self.event_manager.fire_worker_replaced(WorkerReplacedEvent(
             index, old.proc.pid, new.proc.pid, reason, time.time()))
+        self._retire_node(old, "replaced", drained=False)
 
     def _monitor_loop(self):
         """Background failure detector + memory governor: the
@@ -708,6 +897,7 @@ class ProcessQueryRunner:
             try:
                 self.heal(reason="heartbeat")
                 self.run_memory_governance()
+                self.run_autoscaler()
             except Exception as e:
                 # the monitor must survive any tick failure; classify
                 # so the log distinguishes infra churn from bugs
@@ -729,6 +919,50 @@ class ProcessQueryRunner:
                 victim, self.cluster_memory.last_kill_source,
                 totals.get(victim, 0), time.time()))
         return victim
+
+    def run_autoscaler(self) -> Optional[dict]:
+        """One autoscaling tick (monitor-thread driven, also callable
+        directly in tests): resource-group queue depth + running count
+        and the heartbeat-piggybacked blocked-node count feed the
+        deterministic policy; decisions apply through the elastic
+        membership API (add_workers / retire_worker)."""
+        if not SP.value(self.session, "autoscale_enabled"):
+            return None
+        if self.resource_groups is not None:
+            # `queued` counts only on the acquired group (no ancestor
+            # propagation) -> total queue depth is the plain sum;
+            # `running` propagates up, so sum the roots only
+            queued = sum(r[2] for r in self.resource_groups.stats())
+            running = sum(g.running for g in self.resource_groups.roots)
+        else:
+            queued = 0
+            running = len(self.event_manager.running())
+        blocked = self.cluster_memory.cluster_stats().get(
+            "blocked_nodes", 0)
+        with self._heal_lock:
+            size = len(self.workers)
+        decision = self.autoscaler.tick(
+            size=size, queued=queued, running=running,
+            min_workers=int(SP.value(self.session,
+                                     "autoscale_min_workers")),
+            max_workers=int(SP.value(self.session,
+                                     "autoscale_max_workers")),
+            cooldown_s=float(SP.value(self.session,
+                                      "autoscale_cooldown_s")),
+            up_queue_depth=int(SP.value(self.session,
+                                        "autoscale_up_queue_depth")),
+            down_idle_ticks=int(SP.value(self.session,
+                                         "autoscale_down_idle_ticks")),
+            blocked_nodes=blocked)
+        if decision is None:
+            return None
+        if decision["direction"] == "up":
+            self.add_workers(decision["to"] - decision["from"],
+                             reason="autoscale-up")
+        else:
+            self.retire_worker(size - 1, drain=True, timeout=30.0,
+                               reason="autoscale-down")
+        return decision
 
     def inject_task_failure(self, task_prefix: str, times: int = 1):
         """Arm failure injection: the next `times` tasks whose id starts
@@ -762,7 +996,7 @@ class ProcessQueryRunner:
         if new > cur:
             ctx.session_overrides["query_max_memory_bytes"] = new
         width = ctx.task_width if ctx.task_width is not None \
-            else self.n_workers
+            else self.n_workers  # qlint: ignore[guarded-by] point-in-time width hint; the halved replan tolerates staleness
         ctx.task_width = max(1, width // 2)
         ctx.recovery.incr("memory_escalations")
 
@@ -806,7 +1040,16 @@ class ProcessQueryRunner:
         monitor.created()
         t0 = time.perf_counter()
         try:
-            res = self._route_statement(stmt, sql)
+            if self.resource_groups is not None:
+                # admission control: block (or reject at max_queued) in
+                # the user's resource group — the queue the autoscaler
+                # reads (reference: execution/resourcegroups/
+                # InternalResourceGroup.run)
+                group = self.resource_groups.select(self.session.user)
+                with group.run():
+                    res = self._route_statement(stmt, sql)
+            else:
+                res = self._route_statement(stmt, sql)
         except Exception as e:
             monitor.failed(e)
             raise
@@ -1206,12 +1449,13 @@ class ProcessQueryRunner:
             self._task_seq += 1
             return f"q{self._task_seq}a{attempt}"
 
-    def _plan(self, stmt, hbo=None):
+    def _plan(self, stmt, hbo=None, width: Optional[int] = None):
         from .distributed import DistributedQueryRunner
 
         # reuse the exact planning path of the in-process runner
         planning = DistributedQueryRunner(
-            self.connectors, self.session, n_workers=self.n_workers,
+            self.connectors, self.session,
+            n_workers=width or self.n_workers,  # qlint: ignore[guarded-by] point-in-time planning width; fan-out pins ctx.cluster_width
             desired_splits=self.desired_splits,
             broadcast_threshold=self.broadcast_threshold)
         fragments = planning.create_fragments(stmt, hbo=hbo)
@@ -1221,8 +1465,13 @@ class ProcessQueryRunner:
         with ctx.tracer.span(f"execute {qid}", parent=ctx.root_span,
                              qid=qid) as attempt_span:
             ctx.attempt_span = attempt_span
+            # capture the membership width ONCE per attempt: planning
+            # and task fan-out must agree even if an elastic scale-up/
+            # down lands mid-query
+            ctx.cluster_width = self.n_workers  # qlint: ignore[guarded-by] snapshot by design: see comment above
             with ctx.tracer.span("plan", parent=attempt_span):
-                fragments, root = self._plan(stmt, hbo=ctx.hbo)
+                fragments, root = self._plan(stmt, hbo=ctx.hbo,
+                                             width=ctx.cluster_width)
             with ctx.hbo_lock:
                 # a fresh attempt discards the failed attempt's shards
                 ctx.hbo_root = root
@@ -1251,25 +1500,29 @@ class ProcessQueryRunner:
         """All fragments' tasks start immediately; the coordinator runs
         the output stage in-line, pulling from workers while they run."""
         bound = SP.value(self.session, "exchange_max_pending_pages")
+        partial = bool(SP.value(self.session, "partial_stage_retry"))
         locations: Dict[int, dict] = {}
         query_tasks: List[Tuple[Tuple, str]] = []
         result_pages: List[Page] = []
         overlap: Dict[str, bool] = {}
         try:
             for frag in fragments:
-                live = [w for w in self._worker_snapshot() if w.alive]
+                live = self._placeable(self._worker_snapshot())
                 if not live:
                     raise _WorkerLost("no live workers")
                 if frag.output_kind == "output":
                     result_pages = self._run_output_streaming(
-                        frag, root, locations, ctx)
+                        frag, root, locations, ctx, partial=partial)
                 else:
                     locations[frag.fragment_id] = self._start_fragment(
                         qid, frag, live, dict(locations), query_tasks,
-                        bound, ctx)
+                        bound, ctx, partial=partial)
             overlap = self._collect_overlap(query_tasks, ctx)
         finally:
+            self._drop_stream_tasks(qid)
             self._release(query_tasks)
+            if partial:
+                self.stream_spool.delete_prefix(qid)
         rows: List[tuple] = []
         for p in result_pages:
             rows.extend(p.to_rows())
@@ -1283,12 +1536,17 @@ class ProcessQueryRunner:
     def _start_fragment(self, qid: str, frag: PlanFragment,
                         live: List[WorkerHandle], upstream: dict,
                         query_tasks: List, bound: int,
-                        ctx: _QueryCtx) -> dict:
+                        ctx: _QueryCtx, partial: bool = False) -> dict:
         self.cluster_memory.check_killed(qid)
         width = ctx.task_width if ctx.task_width is not None \
-            else self.n_workers
+            else (ctx.cluster_width or self.n_workers)  # qlint: ignore[guarded-by] fallback only when cluster_width unpinned (unit paths)
         ntasks = 1 if frag.partitioning == "single" else width
         placeable = prefer_healthy(live)
+        # topology signal: the workers already holding this stage's
+        # exchange inputs (upstream producer locations) — place_task
+        # prefers them, degenerating to round-robin without signal
+        upstream_addrs = [tuple(a) for loc in upstream.values()
+                          for (a, _tid) in loc["locations"]]
         results = []
         # the streaming fragment span covers scheduling (the launch
         # RPCs); the tasks' own run time shows up in the worker task
@@ -1300,7 +1558,7 @@ class ProcessQueryRunner:
                 task_id = f"{qid}.f{frag.fragment_id}.t{t}.s"
                 self.task_launches.append(task_id)
                 ctx.recovery.incr("task_attempts")
-                worker = placeable[t % len(placeable)]
+                worker = place_task(t, 0, placeable, upstream_addrs)
                 launch_span = ctx.tracer.span(
                     f"launch {task_id}", parent=frag_span,
                     task_id=task_id, attempt=0, span_kind="attempt",
@@ -1320,26 +1578,129 @@ class ProcessQueryRunner:
                     "fault": self.fault_schedule.match(task_id),
                     "hbo": self._hbo_binding(ctx),
                 }, launch_span, attempt=0)
-                try:
-                    # full rpc_request_timeout: the streaming ack is
-                    # fast on a healthy worker, and the property must be
-                    # able to RAISE the bound on slow hosts, not only
-                    # lower it
-                    resp = worker.rpc(req, timeout=ctx.timeout())
-                except OSError:
-                    worker.alive = False
-                    worker.failure_stats.record()
-                    launch_span.set("error_type", EXTERNAL)
-                    launch_span.finish()
-                    raise _WorkerLost(
-                        f"worker {worker.addr} unreachable")
+                if partial:
+                    # durable streams: the worker retains acked frames
+                    # for replay, tees output pages into the external
+                    # spool, and its consumers resolve lost producers
+                    # through the coordinator instead of failing the
+                    # query
+                    req["durable_streams"] = True
+                    req["partial_retry"] = True
+                    req["spool_stream"] = {
+                        "dir": self.stream_spool.base_dir,
+                        "query": qid, "stage": frag.fragment_id,
+                        "task": t, "attempt": 0}
+                while True:
+                    try:
+                        # full rpc_request_timeout: the streaming ack is
+                        # fast on a healthy worker, and the property must
+                        # be able to RAISE the bound on slow hosts, not
+                        # only lower it
+                        resp = worker.rpc(req, timeout=ctx.timeout())
+                        break
+                    except OSError:
+                        worker.alive = False
+                        worker.failure_stats.record()
+                        rest = [w for w in self._placeable(
+                            self._worker_snapshot()) if w is not worker]
+                        if not partial or not rest:
+                            launch_span.set("error_type", EXTERNAL)
+                            launch_span.finish()
+                            raise _WorkerLost(
+                                f"worker {worker.addr} unreachable")
+                        # partial retry: fail the LAUNCH over to another
+                        # worker instead of the whole query; strip the
+                        # fault so an injected kill-worker cannot chain
+                        # through the entire membership
+                        ctx.recovery.record_retry(EXTERNAL)
+                        self._fire_retry(task_id, EXTERNAL, 1)
+                        req = dict(req)
+                        req.pop("fault", None)
+                        worker = place_task(t, 1, rest, upstream_addrs)
                 launch_span.finish()
                 if not resp.get("ok"):
                     ctx.tracer.add_finished(resp.get("spans"))
                     raise self._task_error(resp, task_id)
                 results.append((worker.addr, task_id))
                 query_tasks.append((worker.addr, task_id))
+                if partial:
+                    entry_req = dict(req)
+                    entry_req.pop("fault", None)
+                    with self._stream_lock:
+                        self._stream_tasks[task_id] = {
+                            "req": entry_req,
+                            "addr": tuple(worker.addr),
+                            "restarts": 0, "lock": threading.Lock(),
+                            "ctx": ctx,
+                            "spool": req["spool_stream"],
+                            "query_tasks": query_tasks}
         return {"kind": frag.output_kind, "locations": results}
+
+    def _drop_stream_tasks(self, qid: str):
+        """Forget a finished query's partial-retry registry entries
+        (resolve_task for them then answers None: query is over)."""
+        with self._stream_lock:
+            for tid in [t for t in self._stream_tasks
+                        if t.startswith(qid + ".")]:
+                del self._stream_tasks[tid]
+
+    def _resolve_lost_producer(self, task_id: str, cursor: int,
+                               failed_addr: Tuple[str, int]
+                               ) -> Optional[dict]:
+        """Partial-stage retry (the spooled-exchange upgrade): a
+        consumer lost its stream to producer ``task_id``. Resolution
+        order — (1) a sibling consumer already restarted it elsewhere:
+        repoint; (2) its published output survives in the external
+        spool: serve those durable bytes; (3) restart JUST that task
+        under the same wire id on another worker — never the whole
+        query. The consumer resumes from its ack cursor either way
+        (deterministic re-execution replays identical frames; the
+        spool cursor skips already-consumed pages)."""
+        with self._stream_lock:
+            entry = self._stream_tasks.get(task_id)
+        if entry is None:
+            return None  # query already over (or not partial-retry)
+        with entry["lock"]:
+            if tuple(entry["addr"]) != tuple(failed_addr):
+                # another consumer's resolution already landed
+                return {"addr": list(entry["addr"])}
+            sp = entry["spool"]
+            att = committed_attempt(backend_for(sp["dir"]),
+                                    sp["query"], sp["stage"],
+                                    sp["task"])
+            if att is not None:
+                # task output outlives its worker: serve the spool
+                return {"spool": dict(sp, attempt=att)}
+            if entry["restarts"] >= 3 or self._closed.is_set():
+                return None
+            for w in self._worker_snapshot():
+                if tuple(w.addr) == tuple(failed_addr) and w.alive:
+                    w.alive = False
+                    w.failure_stats.record()
+            cands = [w for w in self._placeable(self._worker_snapshot())
+                     if tuple(w.addr) != tuple(failed_addr)]
+            if not cands:
+                return None
+            entry["restarts"] += 1
+            n = entry["restarts"]
+            req = dict(entry["req"])
+            req.pop("fault", None)
+            ctx = entry["ctx"]
+            worker = place_task(int(sp["task"]), n, cands)
+            try:
+                resp = worker.rpc(req, timeout=ctx.timeout())
+            except OSError:
+                worker.alive = False
+                worker.failure_stats.record()
+                return None  # next consumer poll retries the resolve
+            if not resp.get("ok"):
+                return None
+            entry["addr"] = tuple(worker.addr)
+            self.task_launches.append(f"{task_id}.r{n}")
+            ctx.recovery.record_retry(EXTERNAL)
+            self._fire_retry(task_id, EXTERNAL, n)
+            entry["query_tasks"].append((worker.addr, task_id))
+            return {"addr": list(worker.addr)}
 
     @staticmethod
     def _classify_remote(err: RemoteTaskError) -> Exception:
@@ -1370,7 +1731,8 @@ class ProcessQueryRunner:
 
     def _run_output_streaming(self, frag: PlanFragment, root,
                               locations: Dict[int, dict],
-                              ctx: _QueryCtx) -> List[Page]:
+                              ctx: _QueryCtx,
+                              partial: bool = False) -> List[Page]:
         from ..exec.driver import Driver
         from ..exec.local_planner import (LocalExecutionPlanner,
                                           grouping_options)
@@ -1380,19 +1742,24 @@ class ProcessQueryRunner:
                                       run_driver_blocking)
 
         channels: List[RemoteExchangeChannel] = []
+        # partial retry: the coordinator's own output-stage channels
+        # resolve lost producers in-process (workers RPC the same
+        # resolver through the resolve_task coordinator op)
+        recover = self._resolve_lost_producer if partial else None
 
         def exchange_reader(fragment_id: int, kind: str):
             src = locations[fragment_id]
             if kind == "merge":  # per-producer streams for the merge
                 chans = [RemoteExchangeChannel(
                     [loc], 0, consumer_id=0,
-                    rpc_timeout=ctx.rpc_timeout)
+                    rpc_timeout=ctx.rpc_timeout, recover=recover)
                     for loc in src["locations"]]
                 channels.extend(chans)
                 return chans
             chan = RemoteExchangeChannel(src["locations"], 0,
                                          consumer_id=0,
-                                         rpc_timeout=ctx.rpc_timeout)
+                                         rpc_timeout=ctx.rpc_timeout,
+                                         recover=recover)
             channels.append(chan)
             return chan
 
@@ -1505,7 +1872,7 @@ class ProcessQueryRunner:
         result_pages: List[Page] = []
         try:
             for frag in fragments:
-                live = [w for w in self._worker_snapshot() if w.alive]
+                live = self._placeable(self._worker_snapshot())
                 if not live:
                     raise _WorkerLost("no live workers")
                 if frag.output_kind == "output":
@@ -1554,7 +1921,7 @@ class ProcessQueryRunner:
                             query_tasks: List, spool_mgr,
                             ctx: _QueryCtx, frag_span) -> dict:
         width = ctx.task_width if ctx.task_width is not None \
-            else self.n_workers
+            else (ctx.cluster_width or self.n_workers)  # qlint: ignore[guarded-by] fallback only when cluster_width unpinned (unit paths)
         ntasks = 1 if frag.partitioning == "single" else width
         upstream = {fid: loc for fid, loc in locations.items()}
         spool_dir = None
@@ -1659,10 +2026,9 @@ class ProcessQueryRunner:
                     # ONE snapshot for both scans: a heal swap landing
                     # between two live iterations could mix a dead
                     # handle with its replacement in the candidate set
-                    slots = self._worker_snapshot()
-                    candidates = [w for w in slots
-                                  if w.alive and w not in tried] or \
-                        [w for w in slots if w.alive]
+                    pool = self._placeable(self._worker_snapshot())
+                    candidates = [w for w in pool
+                                  if w not in tried] or pool
                     if not candidates:
                         errors[t] = ("no live workers", EXTERNAL)
                         return
@@ -1686,6 +2052,24 @@ class ProcessQueryRunner:
                     if status in ("win", "superseded"):
                         return
                     if status == "lost-worker":
+                        if spool_dir is not None and \
+                                self._spool_published(spool_dir, frag,
+                                                      t, width):
+                            # kill-after-publish: the task's spool
+                            # output already outlives the dead worker —
+                            # adopt it instead of relaunching; the
+                            # consumers read the spool, release on the
+                            # dead address is best-effort
+                            with reg_lock:
+                                if results[t] is None and not closed:
+                                    results[t] = (worker.addr,
+                                                  attempt_id)
+                                    query_tasks.append(
+                                        (worker.addr, attempt_id))
+                                    durations[t] = time.monotonic() \
+                                        - started[t]
+                                    done[t].set()
+                                    return
                         errors[t] = (f"worker {worker.addr} lost",
                                      EXTERNAL)
                         continue
@@ -1732,6 +2116,19 @@ class ProcessQueryRunner:
         if spool_dir is not None:
             loc["spool_dir"] = spool_dir
         return loc
+
+    @staticmethod
+    def _spool_published(spool_dir: str, frag: PlanFragment, t: int,
+                         width: int) -> bool:
+        """Did task ``t`` fully publish its spool output before its
+        worker died? ExchangeSink publishes each partition file by an
+        atomic link at finish, so existence of EVERY partition file is
+        the commit witness (a kill mid-publish leaves some missing and
+        the normal retry path runs instead)."""
+        nparts = 1 if frag.output_kind in ("single", "broadcast",
+                                           "merge") else width
+        return all(os.path.exists(os.path.join(
+            spool_dir, f"p{p}.t{t}.bin")) for p in range(nparts))
 
     def _supervise(self, ntasks, done, durations, started,
                    current_attempt, fatal, qid, frag, spool_dir,
@@ -1975,6 +2372,24 @@ class ProcessQueryRunner:
         reg.gauge("trino_workers_alive",
                   "Live worker processes").set(
             sum(1 for w in self._worker_snapshot() if w.alive))
+        slots = self._worker_snapshot()
+        reg.gauge("trino_cluster_size",
+                  "Worker slots in the membership (elastic: changes "
+                  "with add_workers/retire_worker)").set(len(slots))
+        joined, retired = self.cluster.counts()
+        nt = reg.counter("trino_nodes_total",
+                         "Membership churn events by kind")
+        nt.inc(joined, event="joined")
+        nt.inc(retired, event="retired")
+        snap = self.autoscaler.snapshot()
+        ad = reg.counter("trino_autoscaler_decisions_total",
+                         "Autoscaler decisions by direction")
+        ad.inc(snap["scale_ups"], direction="up")
+        ad.inc(snap["scale_downs"], direction="down")
+        reg.gauge("trino_autoscaler_target_workers",
+                  "Most recent autoscaler target size").set(
+            snap["target"] if snap["target"] is not None
+            else len(slots))
         return self.cluster_metrics.collect(process_families()
                                             + reg.collect())
 
@@ -1996,6 +2411,15 @@ class ProcessQueryRunner:
                              (st.get("status") or "?").upper(),
                              st.get("rows"), st.get("error_type")))
         return rows
+
+    def runtime_nodes(self) -> list:
+        """Rows for ``system.runtime.nodes``: the membership ledger —
+        every node that ever joined this cluster, its lifecycle state
+        and the cluster generation at which it joined."""
+        return [(n.node_id, f"{n.address[0]}:{n.address[1]}",
+                 n.state.upper(), n.pid, n.generation,
+                 n.reason or None, n.retired_reason or None)
+                for n in self.cluster.snapshot()]
 
 
 class _WorkerLost(Exception):

@@ -63,12 +63,20 @@ class RemoteExchangeChannel:
 
     def __init__(self, locations: List[Tuple[tuple, str]], partition: int,
                  consumer_id: int = 0, max_local: int = 16,
-                 poll_wait: float = 0.5, rpc_timeout: float = 60.0):
+                 poll_wait: float = 0.5, rpc_timeout: float = 60.0,
+                 recover=None):
         self.partition = partition
         self.consumer_id = consumer_id
         self.max_local = max_local
         self.poll_wait = poll_wait
         self.rpc_timeout = rpc_timeout
+        #: partial-stage retry hook: ``recover(task_id, cursor,
+        #: failed_addr) -> resolution dict | None``. When set, a lost
+        #: producer is resolved in place (repoint to its replacement or
+        #: adopt its durable spool output) before the channel escalates
+        #: to ExchangeConnectionLost.
+        self.recover = recover
+        self.recoveries = 0
         self._lock = threading.Lock()
         self._queue: List = []
         self._version = 0
@@ -165,6 +173,9 @@ class RemoteExchangeChannel:
                         self._fail_counts[task_id] = fails
                         self.reconnects += 1
                         if fails > self.RECONNECT_ATTEMPTS:
+                            if self._try_recover(addr, task_id):
+                                progressed = True
+                                break  # pending mutated: re-snapshot
                             raise ExchangeConnectionLost(
                                 f"pull from {addr} task {task_id} "
                                 f"failed {fails} times: {e!r}")
@@ -180,6 +191,9 @@ class RemoteExchangeChannel:
                         msg = head["error"]
                         if head.get("connection_lost") or \
                                 "[connection-lost]" in msg:
+                            if self._try_recover(addr, task_id):
+                                progressed = True
+                                break  # pending mutated: re-snapshot
                             raise ExchangeConnectionLost(msg)
                         from .fault import RemoteTaskError
 
@@ -192,6 +206,9 @@ class RemoteExchangeChannel:
                         cursor = self._cursors[task_id]
                         start = int(head.get("start", cursor))
                         if start > cursor:
+                            if self._try_recover(addr, task_id):
+                                progressed = True
+                                break  # pending mutated: re-snapshot
                             raise ExchangeConnectionLost(
                                 f"stream hole from task {task_id}: "
                                 f"have {cursor}, got start={start}")
@@ -244,6 +261,72 @@ class RemoteExchangeChannel:
                 fired = self._bump_locked()
             for cb in fired:
                 cb()
+
+    def _try_recover(self, addr, task_id: str) -> bool:
+        """Resolve a lost producer in place via the coordinator-backed
+        ``recover`` callback (fetch-loop thread only — ``_pending`` /
+        ``_cursors`` are fetcher-private). Two resolutions succeed:
+
+        - a replacement task address: repoint the pending entry and
+          replay from our ack cursor — the producer re-executes
+          deterministically, so its fresh serializer reproduces frames
+          ``0..cursor-1`` byte-identically and the prefix-drop seam
+          skips them;
+        - the task's committed spool object: decode it from page 0
+          (serde dictionary deltas are positional) and adopt only the
+          pages past the cursor."""
+        if self.recover is None:
+            return False
+        cursor = self._cursors.get(task_id, 0)
+        try:
+            resolution = self.recover(task_id, cursor, addr)
+        except Exception:  # qlint: ignore[taxonomy] best-effort: declining here makes the caller raise ExchangeConnectionLost, which IS classified
+            return False
+        if not resolution:
+            return False
+        entry = (tuple(addr), task_id)
+        if resolution.get("addr"):
+            try:
+                idx = self._pending.index(entry)
+            except ValueError:
+                return False
+            self._pending[idx] = (tuple(resolution["addr"]), task_id)
+            self._fail_counts.pop(task_id, None)
+            self._retry_at[task_id] = time.monotonic() + 0.05
+            self.reconnects += 1
+            self.recoveries += 1
+            return True
+        sp = resolution.get("spool")
+        if not sp:
+            return False
+        from .spool_backend import (BackendSpoolCursor, backend_for,
+                                    partition_key)
+
+        cur = BackendSpoolCursor(
+            backend_for(sp["dir"]),
+            partition_key(sp["query"], sp["stage"], sp["task"],
+                          sp["attempt"], self.partition),
+            start_page=cursor)
+        try:
+            pages = cur.pages()
+        finally:
+            cur.close()
+        if entry in self._pending:
+            self._pending.remove(entry)
+        self._cursors[task_id] = cursor + len(pages)
+        self._fail_counts.pop(task_id, None)
+        self._retry_at.pop(task_id, None)
+        self.recoveries += 1
+        self.pages_received += len(pages)
+        self.rows_received += sum(p.num_rows for p in pages)
+        if pages and self.first_page_ts is None:
+            self.first_page_ts = time.monotonic()
+        with self._lock:
+            self._queue.extend(pages)
+            fired = self._bump_locked()
+        for cb in fired:
+            cb()
+        return True
 
     def _qsize(self) -> int:
         with self._lock:
@@ -303,6 +386,8 @@ class RemoteExchangeChannel:
         if self.reconnects:
             out["reconnects"] = self.reconnects
             out["replayed_frames"] = self.replayed_frames
+        if self.recoveries:
+            out["recoveries"] = self.recoveries
         return out
 
 

@@ -119,6 +119,16 @@ class _TaskState:
         #: armed drop-connection occurrences: result pulls for this task
         #: close mid-frame this many times (FaultSchedule directive)
         self.drop_results = 0
+        #: durable streams (partial-stage retry): retain ALL serialized
+        #: frames instead of discarding acked ones, so a RESTARTED
+        #: consumer (fresh cursor 0) replays the full byte-identical
+        #: stream; memory stays bounded by the consumer-relative flow
+        #: control window
+        self.retain = False
+        #: spool tee for streaming output (partial-stage retry): the
+        #: task's pages also publish to the external spool backend, so
+        #: its output outlives this process
+        self.spool_writer = None
 
 
 class WorkerServer:
@@ -205,8 +215,18 @@ class WorkerServer:
 
                 template_seeded = template_seeds().import_seed(
                     req["template_seed"])
+            sizing_seeded = 0
+            if req.get("sizing_seed"):
+                # exchange-sizing knowledge rides the same transport: a
+                # joiner presizes device exchanges from cluster history
+                # instead of re-learning shape by shape
+                from .device_exchange import SIZING_HISTORY
+
+                sizing_seeded = SIZING_HISTORY.import_seed(
+                    req["sizing_seed"])
             send_msg(sock, {"ok": True, "hbo_seeded": seeded,
-                            "template_seeded": template_seeded})
+                            "template_seeded": template_seeded,
+                            "sizing_seeded": sizing_seeded})
         elif op == "run_task":
             send_msg(sock, self.run_task(req))
         elif op == "get_results":
@@ -261,10 +281,16 @@ class WorkerServer:
 
                 template_seeded = template_seeds().import_seed(
                     req["template_seed"])
+            # sizing observations travel the OTHER way on the same
+            # ping: the coordinator merges them and seeds joiners
+            from .device_exchange import SIZING_HISTORY
+
             send_msg(sock, {"ok": True, "pid": os.getpid(),
                             "tasks": len(self.tasks),
                             "memory": memory,
                             "template_seeded": template_seeded,
+                            "sizing": SIZING_HISTORY.export_seed()
+                            or None,
                             "metrics": self.metrics_families(memory)})
         elif op == "shutdown":
             send_msg(sock, {"ok": True})
@@ -480,6 +506,7 @@ class WorkerServer:
         # streaming: the buffer must exist before we acknowledge, so
         # consumers can start pulling immediately
         frag = req["fragment"]
+        state.retain = bool(req.get("durable_streams"))
         state.buffer = OutputBuffer(
             1 if frag.output_kind in ("single", "merge")
             else req["n_partitions"],
@@ -596,6 +623,10 @@ class WorkerServer:
             if not state.spans:
                 state.spans = tracer.finished()
             self._release_query_pool(req["task_id"])
+            if state.spool_writer is not None \
+                    and state.status != "finished":
+                # never publish a failed attempt's partial frames
+                state.spool_writer.abort()
             for ch in state.channels:
                 ch.close()
 
@@ -688,6 +719,22 @@ class WorkerServer:
         task_index = req["task_index"]
         rpc_timeout = float(req.get("session", {}).get(
             "rpc_request_timeout", 600.0))
+        coordinator = req.get("coordinator")
+        recover = None
+        if streaming and req.get("partial_retry") and coordinator:
+            from .rpc import call as _coord_call
+
+            def recover(lost_task_id, cursor, failed_addr):
+                # partial-stage retry: ask the coordinator where the
+                # lost producer's output lives NOW — a restarted task
+                # (repoint + replay from our ack cursor) or its durable
+                # spool — instead of failing the whole query
+                resp = _coord_call(tuple(coordinator), {
+                    "op": "resolve_task", "task_id": lost_task_id,
+                    "cursor": int(cursor),
+                    "failed_addr": list(failed_addr)},
+                    timeout=rpc_timeout)
+                return resp.get("resolution")
 
         def exchange_reader(fragment_id: int, kind: str):
             src = upstream[fragment_id]
@@ -707,7 +754,8 @@ class WorkerServer:
                 if streaming:
                     chans = [RemoteExchangeChannel([loc], 0,
                                                    consumer_id=task_index,
-                                                   rpc_timeout=rpc_timeout)
+                                                   rpc_timeout=rpc_timeout,
+                                                   recover=recover)
                              for loc in src["locations"]]
                     state.channels.extend(chans)
                     return chans
@@ -734,7 +782,7 @@ class WorkerServer:
             if streaming:
                 chan = RemoteExchangeChannel(
                     src["locations"], part, consumer_id=task_index,
-                    rpc_timeout=rpc_timeout)
+                    rpc_timeout=rpc_timeout, recover=recover)
                 state.channels.append(chan)
                 return chan
 
@@ -787,6 +835,33 @@ class WorkerServer:
                 frag, ops, layout, types_)
         if streaming:
             buffer = state.buffer  # pre-created by run_task
+            ss = req.get("spool_stream")
+            if ss:
+                # tee every emitted page into the external spool: this
+                # task's output then outlives the process, and a
+                # consumer that loses the stream replays committed
+                # pages from the backend. The tee mirrors enqueue's
+                # empty-page skip so spool page N == stream page N
+                # (the ack cursor indexes both identically).
+                from .spool_backend import SpooledTaskWriter, backend_for
+
+                writer = SpooledTaskWriter(
+                    backend_for(ss["dir"]), ss["query"], ss["stage"],
+                    ss["task"], int(ss.get("attempt") or 0),
+                    1 if frag.output_kind in ("single", "merge",
+                                              "broadcast")
+                    else req["n_partitions"])
+                state.spool_writer = writer
+                orig_enqueue = buffer.enqueue
+                broadcast_out = frag.output_kind == "broadcast"
+
+                def tee_enqueue(partition, page, _orig=orig_enqueue,
+                                _w=writer, _bc=broadcast_out):
+                    if page.num_rows:
+                        _w.add(0 if _bc else partition, page)
+                    _orig(partition, page)
+
+                buffer.enqueue = tee_enqueue
         else:
             buffer = OutputBuffer(
                 1 if frag.output_kind in ("single", "merge")
@@ -836,6 +911,19 @@ class WorkerServer:
                 d.collect_operator_metrics()
             state.hbo_actuals = hbo_ctx.collect_actuals(
                 [st for d in drivers for st in d.stats])
+        if streaming and state.spool_writer is not None:
+            if state.abort.is_set():
+                state.spool_writer.abort()
+            else:
+                state.spool_writer.commit()
+                if (fault or {}).get("kind") == "kill-after-publish":
+                    # the spool now owns the output: dying here must
+                    # not cost consumers anything
+                    sys.stderr.write(
+                        f"worker: injected kill after publish for "
+                        f"{req['task_id']}\n")
+                    sys.stderr.flush()
+                    os._exit(137)
         spool_dir = req.get("spool_dir")
         if spool_dir:
             # durable publish BEFORE reporting success: a retried
@@ -865,6 +953,13 @@ class WorkerServer:
                 raise
             self._apply_post_publish_fault(fault or {}, req, spool_dir,
                                            task_index, nparts)
+        if not streaming and (fault or {}).get("kind") \
+                == "kill-after-publish" and not spool_dir:
+            # no durable output was requested: treat as plain kill
+            sys.stderr.write(f"worker: injected kill for "
+                             f"{req['task_id']}\n")
+            sys.stderr.flush()
+            os._exit(137)
         return buffer.total_rows
 
     @staticmethod
@@ -880,6 +975,13 @@ class WorkerServer:
             raise RuntimeError(  # qlint: ignore[taxonomy] chaos harness: untyped crash IS the class under test
                 f"injected failure after spool publish for task "
                 f"{req['task_id']}")
+        if kind == "kill-after-publish":
+            # the process dies right after the durable publish: retried
+            # consumers must be served from the spool, not a relaunch
+            sys.stderr.write(f"worker: injected kill after publish for "
+                             f"{req['task_id']}\n")
+            sys.stderr.flush()
+            os._exit(137)
         if kind == "truncate-spool":
             # tear the last published partition file mid-frame: readers
             # must fail loudly (short read), never return partial rows
@@ -963,7 +1065,10 @@ class WorkerServer:
         with self._lock:
             rs = state.streams.setdefault((partition, consumer),
                                           _RetainedStream())
-        rs.discard_acked(min(ack, cursor))
+        if not state.retain:
+            # durable streams keep every frame: a restarted consumer
+            # re-enters at cursor 0 and must find the full stream
+            rs.discard_acked(min(ack, cursor))
         while True:
             with rs.lock:
                 # serialize newly-drained pages onto the retained tail

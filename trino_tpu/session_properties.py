@@ -88,6 +88,14 @@ hbo_store_path                             runner.py,
 hbo_ewma_alpha                             runner.py,
                                            parallel/distributed.py,
                                            parallel/process_runner.py
+partial_stage_retry                        parallel/process_runner.py
+                                           (workers: shipped dict)
+autoscale_enabled,                         parallel/process_runner.py
+autoscale_min_workers,
+autoscale_max_workers,
+autoscale_cooldown_s,
+autoscale_up_queue_depth,
+autoscale_down_idle_ticks
 ========================================== ===========================
 """
 
@@ -535,6 +543,45 @@ register(SessionProperty(
     "re-shuffle cliff under skew)",
     lambda v: v in ("exact", "history", "legacy"),
     normalize=str.lower))
+register(SessionProperty(
+    "partial_stage_retry", "boolean", False,
+    "Streaming fault tolerance without the barrier: producer tasks "
+    "retain their serialized frames (durable streams), tee output into "
+    "the external spool backend, and on producer loss the coordinator "
+    "restarts ONLY that task — consumers resume from their ack cursors "
+    "(deterministic replay) or adopt the committed spool object, with "
+    "zero whole-query retries (reference: the spooling exchange "
+    "half of fault-tolerant execution, applied per task)"))
+register(SessionProperty(
+    "autoscale_enabled", "boolean", False,
+    "Elastic membership: the coordinator's monitor drives a "
+    "deterministic hysteresis-guarded autoscaler from resource-group "
+    "queue depth + heartbeat snapshots, growing the cluster with "
+    "add_workers and shrinking it with drain-based retire_worker"))
+register(SessionProperty(
+    "autoscale_min_workers", "integer", 1,
+    "Autoscaler floor: scale-down never drops the cluster below this "
+    "many workers, and a below-floor cluster restores immediately",
+    lambda v: v >= 1))
+register(SessionProperty(
+    "autoscale_max_workers", "integer", 8,
+    "Autoscaler ceiling for scale-up decisions",
+    lambda v: v >= 1))
+register(SessionProperty(
+    "autoscale_cooldown_s", "double", 10.0,
+    "Seconds after any scale decision during which the autoscaler "
+    "holds (hysteresis against membership flapping)",
+    lambda v: v >= 0))
+register(SessionProperty(
+    "autoscale_up_queue_depth", "integer", 1,
+    "Queued-query depth (summed over resource groups) that must "
+    "persist for consecutive monitor ticks before the cluster doubles",
+    lambda v: v >= 1))
+register(SessionProperty(
+    "autoscale_down_idle_ticks", "integer", 4,
+    "Consecutive idle monitor ticks (nothing queued or running) "
+    "before ONE worker drains and retires",
+    lambda v: v >= 1))
 
 
 def _parse(prop: SessionProperty, raw):
