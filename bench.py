@@ -988,7 +988,12 @@ def _hbo_smoke() -> dict:
     Part B is the closed-loop witness: a join whose connector
     statistics lie by 7 orders of magnitude must flip to the matmul
     strategy on its second run via recorded history, byte-equal.
-    rc=13 when the flip or the equality fails.
+    Part C is the distribution witness: a distributed join whose
+    connector UNDER-estimates the build (broadcast territory) must
+    re-plan to ``distribution=partitioned [source=hbo]`` on its second
+    run after the material misestimate invalidates the cached fragment
+    plan — byte-equal, with the ``hbo_plan_flips`` counters emitted as
+    a metric line.  rc=13 when any flip or equality fails.
 
     The quantiles RATCHET against the committed ``hbo_qerror_p50`` /
     ``hbo_qerror_p90`` cache entries: the workload is deterministic
@@ -1058,9 +1063,51 @@ def _hbo_smoke() -> dict:
     first = r.execute(sql)
     flipped = "strategy=matmul" in r.explain(sql)
     second = r.execute(sql)
+
+    # Part C: exchange-distribution flip (broadcast -> partitioned),
+    # end-to-end through the distributed runner's fragment-plan cache
+    from trino_tpu.parallel.distributed import DistributedQueryRunner
+
+    class _LyingSmall(MemoryConnector):
+        lies = {
+            ("default", "probe"): TableStatistics(row_count=100_000.0),
+            ("default", "build"): TableStatistics(row_count=2.0),
+        }
+
+        def metadata(self):
+            return _LyingMetadata(super().metadata(), self.lies)
+
+    dconn = _LyingSmall()
+    ds = Session(catalog="memory", schema="default")
+    # pin join ORDER to connector estimates: the witness isolates the
+    # distribution decision
+    ds.properties["hbo_reorder_joins_enabled"] = False
+    dl = LocalQueryRunner({"memory": dconn}, ds)
+    dl.execute("create table probe (k bigint, v bigint)")
+    dl.execute("create table build (k bigint, w bigint)")
+    dl.execute("insert into probe values " + ", ".join(
+        f"({i % 200 + 1}, {i})" for i in range(40)))
+    dl.execute("insert into build values " + ", ".join(
+        f"({i + 1}, {i * 3})" for i in range(200)))
+    dr = DistributedQueryRunner({"memory": dconn}, ds, n_workers=2,
+                                desired_splits=2, broadcast_threshold=50)
+    dsql = ("select probe.k, probe.v, build.w from probe "
+            "join build on probe.k = build.k order by probe.v")
+    dist_before = "distribution=broadcast [source=connector]" \
+        in dr.explain(dsql)
+    dfirst = dr.execute(dsql)
+    dist_after = "distribution=partitioned [source=hbo]" \
+        in dr.explain(dsql)
+    dsecond = dr.execute(dsql)
+    dist_flipped = bool(dist_before and dist_after
+                        and dr.plan_cache.hbo_invalidations >= 1)
+    plan_flips = dict(stats_store.store().plan_flips)
+
     ratios, regressed = _qerror_ratchet(p50, p90, _load_cache())
     out = {
         "ok": bool(flipped and second.rows == first.rows
+                   and dist_flipped and dsecond.rows == dfirst.rows
+                   and plan_flips.get("distribution", 0) >= 1
                    and counters["records"] >= 4 and not regressed),
         "qerror_p50": p50, "qerror_p90": p90,
         "qerror_regressed": regressed,
@@ -1068,6 +1115,9 @@ def _hbo_smoke() -> dict:
         "nodes": counters["nodes"],
         "flipped": flipped,
         "byte_equal": second.rows == first.rows,
+        "dist_flipped": dist_flipped,
+        "dist_byte_equal": dsecond.rows == dfirst.rows,
+        "plan_flips": plan_flips,
         "wall_s": round(time.time() - t0, 2),
     }
     print(json.dumps({"metric": "hbo_qerror_p50", "value": p50,
@@ -1078,6 +1128,10 @@ def _hbo_smoke() -> dict:
                       "unit": "qerror",
                       "vs_baseline": ratios["hbo_qerror_p90"]}),
           flush=True)
+    for kind in ("join_order", "distribution"):
+        print(json.dumps({"metric": "hbo_plan_flips",
+                          "value": plan_flips.get(kind, 0),
+                          "unit": "flips", "kind": kind}), flush=True)
     for name in regressed:
         print(json.dumps({"metric": f"{name}_regressed",
                           "value": ratios[name],

@@ -43,6 +43,29 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _cap_memory_maps():
+    """Every compiled XLA executable pins ~30 memory maps for the life
+    of the process; a full tier-1 run accumulates enough programs to
+    cross the kernel's default ``vm.max_map_count`` (65530) near the
+    90% mark, and the failing ``mmap`` surfaces as a segfault (or hang)
+    inside XLA's next compile or compile-cache read.  Dropping the
+    in-process executable caches between modules once the count gets
+    high keeps the run bounded — the on-disk compilation cache makes
+    the reload of still-needed kernels cheap."""
+    yield
+    try:
+        with open("/proc/self/maps") as f:
+            n = sum(1 for _ in f)
+    except OSError:
+        return
+    if n > 35_000:
+        import gc
+
+        jax.clear_caches()
+        gc.collect()
+
+
 @pytest.fixture(autouse=True)
 def _isolate_template_seeds():
     """The round-17 template-seed store is process-global (like the HBO

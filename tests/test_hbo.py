@@ -742,3 +742,238 @@ def test_sidecar_survives_process_restart_simulation(tmp_path):
         ex = r2.explain(JOIN_SQL)
     assert "strategy=matmul" in ex and "source=hbo" in ex
     assert r2.execute(JOIN_SQL).rows == res1.rows
+
+# ---------------------------------------------------------------------------
+# plan exploration: history drives join ORDER and exchange DISTRIBUTION
+
+
+def _star_runner(**session_props):
+    """fact(12) joining dim1(50) and dim2(2) where the connector's lies
+    INVERT the dimension sizes: estimates say join dim1 first, recorded
+    actuals say join dim2 first."""
+    lies = {
+        ("default", "f"): TableStatistics(row_count=500_000.0),
+        ("default", "d1"): TableStatistics(row_count=2.0),
+        ("default", "d2"): TableStatistics(row_count=50_000.0),
+    }
+    r = _mem_runner(LyingMemoryConnector(lies), **session_props)
+    r.execute("create table f (k bigint, j bigint, v bigint)")
+    r.execute("create table d1 (k bigint, a bigint)")
+    r.execute("create table d2 (j bigint, b bigint)")
+    r.execute("insert into f values " + ", ".join(
+        f"({i % 3 + 1}, {i % 2 + 1}, {i * 10})" for i in range(12)))
+    r.execute("insert into d1 values " + ", ".join(
+        f"({i + 1}, {i * 100})" for i in range(50)))
+    r.execute("insert into d2 values (1, 7), (2, 8)")
+    return r
+
+
+STAR_SQL = ("select f.k, f.j, f.v, d1.a, d2.b from f "
+            "join d1 on f.k = d1.k join d2 on f.j = d2.j "
+            "order by f.v")
+
+
+def _reorder_detail(explain_text: str) -> str:
+    for line in explain_text.splitlines():
+        if "ReorderJoins" in line and "[" in line:
+            return line
+    return ""
+
+
+def _scan_order(explain_text: str, *tables: str):
+    pos = {t: explain_text.find(f"memory.default.{t}") for t in tables}
+    assert all(p >= 0 for p in pos.values()), explain_text
+    return sorted(tables, key=lambda t: pos[t])
+
+
+def test_hbo_reorders_join_order_on_rerun():
+    r = _star_runner()
+    ex1 = r.explain(STAR_SQL)
+    # estimates alone (d1 claims 2 rows): d1 joins first, no history tag
+    assert "(hbo reordered)" not in ex1
+    assert _scan_order(ex1, "d1", "d2") == ["d1", "d2"]
+    res1 = r.execute(STAR_SQL)
+    assert res1.stats["hbo"]["material"] is True
+    ex2 = r.explain(STAR_SQL)
+    # recorded cardinalities re-priced the DP: relations tagged [hbo],
+    # and the chosen order CHANGED versus estimates alone — the
+    # actually-2-row d2 now joins first
+    d2 = _reorder_detail(ex2)
+    assert "[hbo]" in d2
+    assert "(hbo reordered)" in d2
+    assert _scan_order(ex2, "f", "d1", "d2") != \
+        _scan_order(ex1, "f", "d1", "d2")
+    assert stats_store.store().plan_flips.get("join_order", 0) >= 1
+    res2 = r.execute(STAR_SQL)
+    assert res2.rows == res1.rows            # byte-equal flip
+    sorted_by_oracle = sorted(res1.rows, key=lambda t: t[2])
+    assert res1.rows == sorted_by_oracle
+
+
+def test_reorder_gate_keeps_connector_order():
+    r = _star_runner(hbo_reorder_joins_enabled=False)
+    r.execute(STAR_SQL)
+    ex = r.explain(STAR_SQL)
+    assert "(hbo reordered)" not in ex
+    assert _scan_order(ex, "d1", "d2") == ["d1", "d2"]
+    assert stats_store.store().plan_flips.get("join_order", 0) == 0
+
+
+def test_shared_calculator_memoizes_region_estimates(monkeypatch):
+    """One optimize() run prices every (group, version) region ONCE:
+    the per-run shared calculator + RuleContext region memo must make
+    strictly fewer estimator calls than fresh per-application
+    calculators (the pre-round-20 behavior) on a q3-shaped plan."""
+    from trino_tpu.planner.memo import RuleContext
+    from trino_tpu.planner.stats import StatsCalculator
+
+    conn = MemoryConnector()
+    seed = _mem_runner(conn)
+    seed.execute("create table f (k bigint, j bigint, v bigint)")
+    seed.execute("create table d1 (k bigint, a bigint)")
+    seed.execute("create table d2 (j bigint, b bigint)")
+    seed.execute("insert into f values (1, 1, 10), (2, 2, 20)")
+    seed.execute("insert into d1 values (1, 100), (2, 200)")
+    seed.execute("insert into d2 values (1, 7), (2, 8)")
+    sql = ("select f.k, f.j, f.v, d1.a, d2.b from f "
+           "join d1 on f.k = d1.k join d2 on f.j = d2.j")
+
+    calls = {"n": 0}
+    orig_stats = StatsCalculator.stats
+
+    def counting(self, node):
+        calls["n"] += 1
+        return orig_stats(self, node)
+
+    monkeypatch.setattr(StatsCalculator, "stats", counting)
+    _mem_runner(conn).explain(sql)
+    shared = calls["n"]
+
+    # pre-shared-calculator behavior: no cross-rule region memo and a
+    # fresh calculator per shared_stats() consult
+    monkeypatch.setattr(RuleContext, "_region_key",
+                        lambda self, leaf: None)
+
+    def fresh(self):
+        return StatsCalculator(self.metadata, history=self.hbo)
+
+    monkeypatch.setattr(RuleContext, "shared_stats", fresh)
+    calls["n"] = 0
+    _mem_runner(conn).explain(sql)
+    assert shared < calls["n"], \
+        f"shared calculator made {shared} estimator calls, " \
+        f"per-application calculators made {calls['n']}"
+
+
+def _dist_pair(**session_props):
+    """Distributed runner over a lying build side: the connector claims
+    2 build rows (broadcast territory under threshold=50); the table
+    actually has 200 (partitioned territory)."""
+    from trino_tpu.parallel.distributed import DistributedQueryRunner
+
+    lies = {
+        ("default", "probe"): TableStatistics(row_count=100_000.0),
+        ("default", "build"): TableStatistics(row_count=2.0),
+    }
+    conn = LyingMemoryConnector(lies)
+    s = Session(catalog="memory", schema="default")
+    # keep join ORDER pinned to connector estimates so the witness
+    # isolates the distribution decision
+    s.properties["hbo_reorder_joins_enabled"] = False
+    s.properties.update(session_props)
+    local = LocalQueryRunner({"memory": conn}, s)
+    local.execute("create table probe (k bigint, v bigint)")
+    local.execute("create table build (k bigint, w bigint)")
+    local.execute("insert into probe values " + ", ".join(
+        f"({i % 200 + 1}, {i})" for i in range(40)))
+    local.execute("insert into build values " + ", ".join(
+        f"({i + 1}, {i * 3})" for i in range(200)))
+    r = DistributedQueryRunner({"memory": conn}, s, n_workers=2,
+                               desired_splits=2, broadcast_threshold=50)
+    return r
+
+
+DIST_SQL = ("select probe.k, probe.v, build.w from probe "
+            "join build on probe.k = build.k order by probe.v")
+
+
+def test_distribution_flips_to_partitioned_on_rerun():
+    r = _dist_pair()
+    ex1 = r.explain(DIST_SQL)
+    assert "distribution=broadcast [source=connector]" in ex1
+    res1 = r.execute(DIST_SQL)
+    # the 2-vs-200 build misestimate sits on a DISTRIBUTION decision
+    # node: material, so the cached fragment plan is invalidated
+    assert res1.stats["hbo"]["material"] is True
+    assert r.plan_cache.hbo_invalidations >= 1
+    ex2 = r.explain(DIST_SQL)
+    assert "distribution=partitioned [source=hbo]" in ex2
+    assert stats_store.store().plan_flips.get("distribution", 0) >= 1
+    res2 = r.execute(DIST_SQL)
+    assert res2.rows == res1.rows            # byte-equal flip
+    # converged: the third run reuses the re-planned cached fragments
+    res3 = r.execute(DIST_SQL)
+    assert res3.rows == res1.rows
+    assert res3.stats.get("plan_cache") == "hit"
+
+
+def test_distribution_gate_keeps_connector_choice():
+    r = _dist_pair(hbo_distribution_enabled=False)
+    r.execute(DIST_SQL)
+    ex = r.explain(DIST_SQL)
+    # est~ annotations stay history-fed (a different, ungated surface);
+    # the DISTRIBUTION decision itself must ignore the observed rows
+    assert "distribution=broadcast [source=connector]" in ex
+    assert "distribution=partitioned" not in ex
+    assert "distribution=broadcast [source=hbo]" not in ex
+    assert stats_store.store().plan_flips.get("distribution", 0) == 0
+
+
+def test_spill_hint_refuses_broadcast():
+    """A build that spilled on a prior run must not be replicated even
+    when its observed cardinality is comfortably under the broadcast
+    threshold."""
+    from trino_tpu.parallel.distributed import DistributedQueryRunner
+
+    conn = MemoryConnector()
+    s = Session(catalog="memory", schema="default")
+    s.properties["hbo_reorder_joins_enabled"] = False
+    local = LocalQueryRunner({"memory": conn}, s)
+    local.execute("create table probe (k bigint, v bigint)")
+    local.execute("create table build (k bigint, w bigint)")
+    local.execute("insert into probe values (1, 10), (2, 20), (3, 30)")
+    local.execute("insert into build values (1, 7), (2, 8), (3, 9)")
+    r = DistributedQueryRunner({"memory": conn}, s, n_workers=2,
+                               desired_splits=2, broadcast_threshold=50)
+    sql = ("select probe.k, probe.v, build.w from probe "
+           "join build on probe.k = build.k order by probe.v")
+    res1 = r.execute(sql)
+    # 3 observed build rows < 50: still broadcast
+    assert "distribution=broadcast" in r.explain(sql)
+    # inject a spill record onto every recorded node of the statement
+    # (the hybrid-join runtime does this for the build it spilled)
+    store = stats_store.store()
+    for stmt_fp, st in list(store._stmts.items()):
+        store.record_query(stmt_fp, st["snap"], [
+            {"fp": fp, "name": h.name, "rows": h.rows,
+             "spill": {"fanout": 4, "fraction": 0.5}}
+            for fp, h in st["nodes"].items()])
+    ex = r.explain(sql)
+    assert "distribution=partitioned [source=hbo]" in ex
+    assert r.execute(sql).rows == res1.rows
+
+
+def test_plan_flips_metric_family():
+    store = stats_store.store()
+    store.record_query("s", "snap", [{"fp": "n", "name": "X",
+                                      "rows": 1.0}])
+    store.note_plan_flip("join_order")
+    store.note_plan_flip("distribution")
+    store.note_plan_flip("distribution")
+    fams = {f["name"]: f for f in store.families()}
+    fam = fams["trino_hbo_plan_flips"]
+    assert fam["type"] == "counter"
+    by_kind = {tuple(sorted(l.items())): v for l, v in fam["samples"]}
+    assert by_kind[(("kind", "join_order"),)] == 1
+    assert by_kind[(("kind", "distribution"),)] == 2
+    assert store.counters()["plan_flips"] == 3

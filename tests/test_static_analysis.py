@@ -1473,6 +1473,28 @@ def test_gate_passes_are_not_blind_on_the_real_repo(repo_findings):
     assert "trino_tpu.cache:ProcessorCache.get" in builders
     assert "trino_tpu.cache:QueryCache.parse" in builders
     assert "trino_tpu.parallel.mesh_query:_cached_program" in builders
+    # round 20: the HBO plan-exploration sites must stay visible.  The
+    # optimizer's per-run region-estimate memo is a cached builder
+    # (an unkeyed session/env read inside it would poison every
+    # optimize() of the process) ...
+    assert "trino_tpu.planner.memo:RuleContext.region_stats" in builders
+    assert builders[
+        "trino_tpu.planner.memo:RuleContext.region_stats"].kind == "memo"
+    # ... and the broadcast-vs-partitioned DISTRIBUTION decision site
+    # is indexed, including its history-flip counter call — a rename
+    # would silently blind the cache-coherence walk to the decision
+    vjoin = next(
+        (f for f in index.iter_functions()
+         if f.module == "trino_tpu.planner.exchanges"
+         and f.qualname == "ExchangePlanner._v_JoinNode"), None)
+    assert vjoin is not None
+    assert any(c.chain.split(".")[-1] == "note_plan_flip"
+               for c in vjoin.calls), \
+        sorted(c.chain for c in vjoin.calls)
+    # the plan-exploration session gates are declared with read sites
+    # in the modules that enforce them
+    assert declared["hbo_reorder_joins_enabled"][0] == "boolean"
+    assert declared["hbo_distribution_enabled"][0] == "boolean"
     # ... the resource-lifecycle pass must see the closeables ...
     from trino_tpu.analysis.resource_lifecycle import (
         closeable_classes, closeable_factories)

@@ -69,6 +69,15 @@ class DistributedQueryRunner:
         self.broadcast_threshold = broadcast_threshold \
             if broadcast_threshold is not None \
             else SP.value(self.session, "broadcast_join_threshold")
+        from ..cache import PlanCache
+
+        #: fragment-plan cache (same PlanCache + key discipline as the
+        #: local runner's): repeat statements skip plan/optimize/
+        #: exchange planning, and a MATERIAL history misestimate on a
+        #: decision node — join inputs, grouped aggs, and the
+        #: DISTRIBUTION build sides — invalidates the shape so the
+        #: next run re-plans from history
+        self.plan_cache = PlanCache()
 
     # ------------------------------------------------------------------
 
@@ -94,7 +103,9 @@ class DistributedQueryRunner:
             root, self.metadata, planner.allocator,
             self.broadcast_threshold,
             SP.value(self.session, "join_distribution_type"),
-            scale_writers=SP.value(self.session, "scale_writers_enabled"))
+            scale_writers=SP.value(self.session, "scale_writers_enabled"),
+            hbo=hbo if SP.value(self.session,
+                                "hbo_distribution_enabled") else None)
         if trace is not None:  # exchange planning rebuilt the root node
             root.optimizer_trace = trace
         self._root = root
@@ -104,8 +115,13 @@ class DistributedQueryRunner:
     def explain(self, sql: Optional[str], stmt=None) -> str:
         from ..planner.optimizer import provenance_lines
 
+        if stmt is None:
+            stmt = parse_statement(sql)
+        # EXPLAIN plans through the statement's history view, so the
+        # rendered join order / distribution / strategy choices are
+        # exactly what the next execution would run
         text = fragments_str(self.create_fragments(
-            stmt if stmt is not None else sql))
+            stmt, hbo=self._hbo_context(stmt)))
         prov = provenance_lines(self._root)
         return text + ("\n" + "\n".join(prov) if prov else "")
 
@@ -188,7 +204,18 @@ class DistributedQueryRunner:
         from ..exec.stats import QueryStatsTree, StageStatsTree
 
         self._hbo = hbo_ctx = self._hbo_context(stmt)
-        fragments = self.create_fragments(stmt, hbo=hbo_ctx)
+        key = self._plan_cache_key(stmt)
+        cached = self.plan_cache.lookup(key) if key is not None else None
+        plan_hit = cached is not None
+        if cached is not None:
+            self._root, self._fragments = cached
+            fragments = self._fragments
+        else:
+            fragments = self.create_fragments(stmt, hbo=hbo_ctx)
+            if key is not None:
+                self.plan_cache.store(key, (self._root, self._fragments),
+                                      128)
+        self._plan_shape = key[0] if key is not None else None
         root: OutputNode = self._root
         buffers: Dict[int, OutputBuffer] = {}
         result_pages: List[Page] = []
@@ -242,6 +269,8 @@ class DistributedQueryRunner:
             stats["streaming_overlap"] = {
                 fid: buf.overlapped for fid, buf in buffers.items()
                 if isinstance(buf, OutputBuffer)}
+        if plan_hit:
+            stats["plan_cache"] = "hit"
         if hbo_ctx is not None:
             summary = self._hbo_record(hbo_ctx, root, stats)
             if summary:
@@ -268,10 +297,34 @@ class DistributedQueryRunner:
         self._memory_pool.close()  # reap spill files, free residue
         return QueryResult(names, types_, rows, stats=stats)
 
+    def _plan_cache_key(self, stmt) -> Optional[tuple]:
+        """Fragment-plan cache key, or None when uncacheable: mirrors
+        the local runner's discipline (shape + literals + session and
+        snapshot fingerprints — SET SESSION and DDL/writes move the
+        key), plus the planning inputs owned by this runner."""
+        if not SP.value(self.session, "plan_cache_enabled"):
+            return None
+        if not isinstance(stmt, ast.QueryStatement):
+            return None
+        from ..cache import (normalize_statement, session_fingerprint,
+                             snapshot_fingerprint, statement_catalogs)
+
+        shape, literals = normalize_statement(stmt)
+        snap = snapshot_fingerprint(
+            statement_catalogs(stmt, self.session), self.metadata)
+        if snap is None:
+            return None
+        return (shape, literals, session_fingerprint(self.session),
+                snap, self.n_workers, self.desired_splits,
+                self.broadcast_threshold)
+
     def _hbo_record(self, hbo_ctx, root, stats) -> Optional[dict]:
         """Fold this query's per-node actuals (summed across every
         stage's tasks) into the history store; stashes the estimate
-        map for EXPLAIN ANALYZE's per-node Q-error rendering."""
+        map for EXPLAIN ANALYZE's per-node Q-error rendering.  A
+        material misestimate on a decision node (join input, grouped
+        agg, or a DISTRIBUTION build side) drops cached fragment plans
+        of the shape — the next run re-plans against history."""
         op_stats = [o for s in self._stage_stats
                     for t in s.tasks for o in t.operators]
         est = hbo_ctx.estimates(root, self.metadata)
@@ -279,9 +332,13 @@ class DistributedQueryRunner:
         scan_rows = sum(o.output_rows for o in op_stats
                         if o.name == "TableScanOperator")
         mem = stats.get("memory") or {}
-        return hbo_ctx.record(root, self.metadata, op_stats,
-                              peak_bytes=mem.get("peak_bytes", 0),
-                              scan_rows=scan_rows, estimates=est)
+        summary = hbo_ctx.record(root, self.metadata, op_stats,
+                                 peak_bytes=mem.get("peak_bytes", 0),
+                                 scan_rows=scan_rows, estimates=est)
+        shape = getattr(self, "_plan_shape", None)
+        if summary and summary["material"] and shape is not None:
+            self.plan_cache.invalidate_shape(shape)
+        return summary
 
     # ----------------------------------------------- streaming mode ----
 

@@ -52,7 +52,7 @@ class ExchangePlanner:
     def __init__(self, metadata: Metadata, allocator: SymbolAllocator,
                  broadcast_threshold: float = BROADCAST_THRESHOLD,
                  join_distribution: str = "AUTOMATIC",
-                 scale_writers: bool = False):
+                 scale_writers: bool = False, hbo=None):
         from .stats import StatsCalculator
 
         self.metadata = metadata
@@ -60,7 +60,17 @@ class ExchangePlanner:
         self.broadcast_threshold = broadcast_threshold
         self.join_distribution = join_distribution
         self.scale_writers = scale_writers
-        self._stats = StatsCalculator(metadata)
+        #: history view (telemetry.stats_store.HboContext): observed
+        #: build rows beat connector estimates in the broadcast-vs-
+        #: partitioned comparison, and a build that SPILLED on a prior
+        #: run refuses broadcast outright
+        self.hbo = hbo
+        self._stats = StatsCalculator(metadata, history=hbo)
+        # connector-only shadow estimator: prices the same build from
+        # estimates alone so a history-driven decision change is
+        # counted (hbo_plan_flips{kind="distribution"})
+        self._stats_conn = StatsCalculator(metadata) \
+            if hbo is not None else None
 
     def run(self, root: OutputNode) -> OutputNode:
         node, dist = self.visit(root.source)
@@ -159,9 +169,15 @@ class ExchangePlanner:
         rkeys = [r for _, r in node.criteria]
 
         # stats-based build-size estimate: predicate selectivity and
-        # join/agg cardinality included, not just base table rows
-        # (reference: CostComparator driving the distribution choice)
-        right_rows = self._stats.stats(node.right).row_count
+        # join/agg cardinality included, not just base table rows, and
+        # HBO-observed rows beating both (reference: CostComparator
+        # driving the distribution choice)
+        bstats = self._stats.stats(node.right)
+        right_rows = bstats.row_count
+        spill = self.hbo.spill_hint(self.hbo.fp(node.right)) \
+            if self.hbo is not None else None
+        dist = dsource = None
+        can_partition = bool(node.criteria) and ldist not in (SINGLE, ANY)
         if node.join_type == "full":
             # broadcast would emit each unmatched build row once PER
             # probe task; FULL must co-partition both sides on the join
@@ -174,12 +190,30 @@ class ExchangePlanner:
             partitioned = True
         elif self.join_distribution == "BROADCAST":
             partitioned = False
+            dist, dsource = "broadcast", "session"
         elif self.join_distribution == "PARTITIONED":
-            partitioned = bool(node.criteria) and ldist not in (SINGLE, ANY)
+            partitioned = can_partition
+            if partitioned:
+                dist, dsource = "partitioned", "session"
         else:
-            partitioned = (right_rows > self.broadcast_threshold
-                           and bool(node.criteria)
-                           and ldist not in (SINGLE, ANY))
+            # a build history knows spilled must not be replicated: a
+            # copy per probe task of something that already overflowed
+            # one task's memory is strictly worse than partitioning it
+            partitioned = can_partition and (
+                right_rows > self.broadcast_threshold
+                or spill is not None)
+            if can_partition:
+                dist = "partitioned" if partitioned else "broadcast"
+                dsource = "hbo" if (bstats.source == "hbo"
+                                    or (partitioned and spill is not None)
+                                    ) else "connector"
+                if self._stats_conn is not None:
+                    conn_rows = self._stats_conn.stats(
+                        node.right).row_count
+                    if (conn_rows > self.broadcast_threshold) \
+                            != partitioned \
+                            and self.hbo.store is not None:
+                        self.hbo.store.note_plan_flip("distribution")
         if partitioned:
             if ldist != _hash(lkeys):
                 left = ExchangeNode(left, "hash", lkeys)
@@ -191,12 +225,20 @@ class ExchangePlanner:
             # replicated to every probe task
             if ldist in (SINGLE, ANY):
                 right = self._to_single(right, rdist)
+                dist = dsource = None  # no distribution choice was made
             else:
                 right = ExchangeNode(right, "broadcast", [])
+        if not partitioned:
             out_dist = ldist
-        return JoinNode(node.join_type, left, right, node.criteria,
-                        node.filter_expr, node.strategy,
-                        node.strategy_detail), out_dist
+        out = JoinNode(node.join_type, left, right, node.criteria,
+                       node.filter_expr, node.strategy,
+                       node.strategy_detail)
+        if dist is not None:
+            # plain attrs (the est_rows pattern): ride to EXPLAIN and
+            # the history decision-node walk without moving the node's
+            # fingerprint
+            out.distribution, out.distribution_source = dist, dsource
+        return out, out_dist
 
     def _v_CrossJoinNode(self, node: CrossJoinNode):
         left, ldist = self.visit(node.left)
@@ -331,6 +373,8 @@ def add_exchanges(root: OutputNode, metadata: Metadata,
                   allocator: SymbolAllocator,
                   broadcast_threshold: float = BROADCAST_THRESHOLD,
                   join_distribution: str = "AUTOMATIC",
-                  scale_writers: bool = False) -> OutputNode:
+                  scale_writers: bool = False,
+                  hbo=None) -> OutputNode:
     return ExchangePlanner(metadata, allocator, broadcast_threshold,
-                           join_distribution, scale_writers).run(root)
+                           join_distribution, scale_writers,
+                           hbo=hbo).run(root)

@@ -165,14 +165,65 @@ class Rule:
 
 
 class RuleContext:
-    def __init__(self, lookup: Lookup, metadata, allocator, session):
+    def __init__(self, lookup: Lookup, metadata, allocator, session,
+                 hbo=None, stats=None):
         self.lookup = lookup
         self.metadata = metadata
         self.allocator = allocator
         self.session = session
+        #: the query's history view (telemetry.stats_store.HboContext):
+        #: cost-based rules price candidates against recorded actuals
+        self.hbo = hbo
+        #: ONE StatsCalculator per optimize() run, shared by every rule
+        #: application (ReorderJoins used to build a fresh estimator per
+        #: region, re-pricing identical subtrees from scratch)
+        self.stats_calculator = stats
+        # per-(group id, version) estimate memo: a region re-ordered
+        # because ONE child changed reuses every unchanged relation's
+        # estimate instead of re-walking its subtree
+        self._region_stats: Dict[tuple, object] = {}
+        self.stats_memo_hits = 0
 
     def extract(self, node: PlanNode) -> PlanNode:
         return self.lookup.memo.extract(node)
+
+    def shared_stats(self):
+        """The run's shared, node-memoized StatsCalculator (history-fed
+        when the query has one), built lazily for bare contexts."""
+        if self.stats_calculator is None:
+            from .stats import StatsCalculator
+
+            self.stats_calculator = StatsCalculator(self.metadata,
+                                                    history=self.hbo)
+        return self.stats_calculator
+
+    def region_stats(self, leaf: PlanNode, concrete: PlanNode):
+        """Estimate one join-region relation, memoized per (group id,
+        version[, sunk predicate]): group versions only move when a
+        rule rewrites the group, so an unchanged relation prices once
+        per optimize() run no matter how many regions re-order."""
+        key = self._region_key(leaf)
+        if key is not None:
+            hit = self._region_stats.get(key)
+            if hit is not None:
+                self.stats_memo_hits += 1
+                return hit
+        got = self.shared_stats().stats(concrete)
+        if key is not None:
+            self._region_stats[key] = got
+        return got
+
+    def _region_key(self, leaf: PlanNode):
+        from .plan import FilterNode
+
+        memo = self.lookup.memo
+        if isinstance(leaf, GroupReference):
+            return (leaf.group_id, memo.versions[leaf.group_id], None)
+        if isinstance(leaf, FilterNode) and \
+                isinstance(leaf.source, GroupReference):
+            gid = leaf.source.group_id
+            return (gid, memo.versions[gid], repr(leaf.predicate))
+        return None
 
 
 class IterativeOptimizer:
@@ -184,7 +235,7 @@ class IterativeOptimizer:
     MAX_PER_GROUP = 50  # per-(rule, group) firing cap: termination net
 
     def __init__(self, rules: Sequence[Rule], metadata, allocator,
-                 session=None):
+                 session=None, hbo=None, stats=None):
         self.rules = list(rules)
         self._by_cls: Dict[Type, List[Rule]] = {}
         for r in self.rules:
@@ -193,6 +244,10 @@ class IterativeOptimizer:
         self.metadata = metadata
         self.allocator = allocator
         self.session = session
+        self.hbo = hbo
+        #: shared per-run estimator handed to the RuleContext (and
+        #: readable by tests asserting the estimator-call count)
+        self.stats_calculator = stats
         #: provenance: (rule_name, detail) in application order —
         #: surfaced by EXPLAIN (round-4 verdict asked for rule
         #: provenance)
@@ -204,7 +259,9 @@ class IterativeOptimizer:
         memo = Memo()
         lookup = Lookup(memo)
         ctx = RuleContext(lookup, self.metadata, self.allocator,
-                          self.session)
+                          self.session, hbo=self.hbo,
+                          stats=self.stats_calculator)
+        self.stats_calculator = ctx.shared_stats()
         root_gid = memo.insert(root)
         self._explore_group(memo, lookup, ctx, root_gid)
         return memo.extract(memo.node(root_gid))

@@ -41,13 +41,22 @@ def optimize(root: OutputNode, metadata: Metadata,
     column-pruning/cleanup passes (the reference also runs
     PruneUnreferencedOutputs-style passes outside exploration).
     ``hbo`` (telemetry.stats_store.HboContext) feeds recorded runtime
-    actuals into the kernel-strategy cost rules — history beats
-    connector estimates."""
+    actuals into the cost-based rules — join-order exploration
+    (``hbo_reorder_joins_enabled``) and the kernel-strategy rules all
+    price through ONE shared node-memoized StatsCalculator per run;
+    history beats connector estimates."""
+    from .. import session_properties as SP
     from .memo import IterativeOptimizer
     from .rules import default_rules
+    from .stats import StatsCalculator
 
+    reorder_hbo = hbo
+    if hbo is not None and session is not None and \
+            not SP.value(session, "hbo_reorder_joins_enabled"):
+        reorder_hbo = None
+    calc = StatsCalculator(metadata, history=reorder_hbo)
     engine = IterativeOptimizer(default_rules(), metadata, allocator,
-                                session)
+                                session, hbo=reorder_hbo, stats=calc)
     node = engine.optimize(root.source)
     opt = Optimizer(metadata, allocator, session)
     node = opt.prune(node, {s.name for s in root.outputs})
@@ -57,9 +66,12 @@ def optimize(root: OutputNode, metadata: Metadata,
     #: PlanNode carries its source rule via PlanNodeIdAllocator tags)
     out.optimizer_trace = list(engine.trace)
     # kernel-strategy annotation runs LAST: the choices must land on
-    # the final plan nodes the local planner and EXPLAIN read
-    out.optimizer_trace += annotate_kernel_strategies(node, metadata,
-                                                      session, hbo=hbo)
+    # the final plan nodes the local planner and EXPLAIN read.  It
+    # shares the run's calculator when the history views agree (they
+    # only diverge when hbo_reorder_joins_enabled gated reordering off)
+    out.optimizer_trace += annotate_kernel_strategies(
+        node, metadata, session, hbo=hbo,
+        calc=calc if reorder_hbo is hbo else None)
     slots = template_param_slots(out)
     if slots:
         out.optimizer_trace.append((
@@ -398,7 +410,8 @@ def choose_agg_strategy(ndv_estimate: float, n_devices: int = 1,
 
 
 def annotate_kernel_strategies(node: PlanNode, metadata: Metadata,
-                               session=None, hbo=None) -> List[tuple]:
+                               session=None, hbo=None,
+                               calc=None) -> List[tuple]:
     """Post-optimization pass: stamp every JoinNode with the probe
     strategy and every grouped AggregationNode with the merge shape the
     cost model picks, honoring the session overrides.  ``hbo`` feeds
@@ -419,7 +432,8 @@ def annotate_kernel_strategies(node: PlanNode, metadata: Metadata,
         join_override = agg_override = "AUTOMATIC"
         max_range = SP.prop_value({}, "matmul_join_max_key_range")
         max_table = SP.prop_value({}, "global_hash_agg_max_table")
-    calc = StatsCalculator(metadata, history=hbo)
+    if calc is None:
+        calc = StatsCalculator(metadata, history=hbo)
     trace: List[tuple] = []
 
     def walk(n: PlanNode):
@@ -478,7 +492,26 @@ def _replace_source(node: PlanNode, src: PlanNode) -> PlanNode:
     return _replace_sources(node, [src])
 
 
+#: fingerprint-neutral annotation attrs stamped onto final plan nodes
+#: (annotate_kernel_strategies, ExchangePlanner's distribution choice);
+#: a structural rebuild must carry them or the fragmenter would strip
+#: EXPLAIN provenance from every node above an exchange cut
+_ANNOTATION_ATTRS = ("est_rows", "est_source", "distribution",
+                     "distribution_source")
+
+
 def _replace_sources(node: PlanNode, sources: List[PlanNode]) -> PlanNode:
+    out = _rebuild_with_sources(node, sources)
+    if out is not node:
+        for attr in _ANNOTATION_ATTRS:
+            v = getattr(node, attr, None)
+            if v is not None:
+                setattr(out, attr, v)
+    return out
+
+
+def _rebuild_with_sources(node: PlanNode,
+                          sources: List[PlanNode]) -> PlanNode:
     if isinstance(node, FilterNode):
         return FilterNode(sources[0], node.predicate)
     if isinstance(node, ProjectNode):

@@ -536,6 +536,13 @@ def plan_tree_str(node: PlanNode, indent: int = 0) -> str:
             detail += f" strategy={node.strategy}"
             if node.strategy_detail:
                 detail += f" [{node.strategy_detail}]"
+        # exchange planning's broadcast-vs-partitioned choice, with the
+        # estimate source that decided it (hbo = observed build rows or
+        # a spill-hinted build refusing broadcast)
+        dist = getattr(node, "distribution", None)
+        if dist is not None:
+            detail += (f" distribution={dist} "
+                       f"[source={node.distribution_source}]")
     elif isinstance(node, (SortNode, TopNNode)):
         detail = " " + ", ".join(
             f"{o.symbol.name} {'asc' if o.ascending else 'desc'}"

@@ -454,11 +454,51 @@ class ReorderJoins(Rule):
 
     def _order(self, ctx: RuleContext, leaves: List[PlanNode],
                rel_syms: List[Set[str]], equi):
-        from .stats import StatsCalculator
+        """Order the region through the optimize() run's ONE shared,
+        node-memoized ``StatsCalculator`` (history-fed when the query
+        has an HboContext).  When recorded actuals priced any relation
+        (``source=hbo``), a second pricing pass from connector
+        estimates alone detects whether history CHANGED the chosen
+        order — the ``hbo_plan_flips{kind="join_order"}`` witness.
 
-        calc = StatsCalculator(ctx.metadata)
+        A region holding a ``ParamRef`` (a plan-template trial) prices
+        from connector estimates alone: recorded actuals belong to ONE
+        literal binding, and a literal-poisoned cardinality could flip
+        the param-filtered side onto the build — breaking the
+        one-build-serves-all-lanes batching invariant for every other
+        binding the template must serve."""
+        from .optimizer import template_param_slots
+
+        if any(template_param_slots(ctx.extract(l)) for l in leaves):
+            from .stats import StatsCalculator
+
+            ordered = self._order_with(ctx, StatsCalculator(ctx.metadata),
+                                       leaves, rel_syms, equi, memo=False)
+            return None if ordered is None else ordered[:2]
+        ordered = self._order_with(ctx, ctx.shared_stats(), leaves,
+                                   rel_syms, equi, memo=True)
+        if ordered is None:
+            return None
+        plan, desc, hbo_sourced = ordered
+        if hbo_sourced and ctx.hbo is not None:
+            from .stats import StatsCalculator
+
+            base = self._order_with(ctx, StatsCalculator(ctx.metadata),
+                                    leaves, rel_syms, equi, memo=False)
+            if base is not None and \
+                    base[1] != desc.replace("[hbo]", ""):
+                if ctx.hbo.store is not None:
+                    ctx.hbo.store.note_plan_flip("join_order")
+                desc += " (hbo reordered)"
+        return plan, desc
+
+    def _order_with(self, ctx: RuleContext, calc, leaves: List[PlanNode],
+                    rel_syms: List[Set[str]], equi, memo: bool):
         n = len(leaves)
         concrete = [ctx.extract(l) for l in leaves]
+        #: relations whose cardinality came from recorded history —
+        #: tagged ``r<i>[hbo]`` in the order provenance
+        hbo_leaves: Set[int] = set()
 
         def criteria_between(left_set: int, right_set: int):
             crit = []
@@ -469,16 +509,19 @@ class ReorderJoins(Rule):
                     crit.append((rs, ls))
             return crit
 
-        if n > self.MAX_DP:
-            return self._order_greedy(ctx, calc, leaves, concrete,
-                                      rel_syms, equi)
-
         # exact DP over subsets: best[S] = (cumulative cost, rows,
         # concrete tree for costing, builder for the real tree)
         best: Dict[int, Tuple[float, float, PlanNode, object]] = {}
         for i in range(n):
-            rows = calc.stats(concrete[i]).row_count
-            best[1 << i] = (0.0, rows, concrete[i], ("leaf", i))
+            st = ctx.region_stats(leaves[i], concrete[i]) if memo \
+                else calc.stats(concrete[i])
+            if st.source == "hbo":
+                hbo_leaves.add(i)
+            best[1 << i] = (0.0, st.row_count, concrete[i], ("leaf", i))
+
+        if n > self.MAX_DP:
+            return self._order_greedy(ctx, calc, leaves, concrete,
+                                      rel_syms, equi, best, hbo_leaves)
         full = (1 << n) - 1
         for size in range(2, n + 1):
             for s in _subsets_of_size(n, size):
@@ -529,11 +572,14 @@ class ReorderJoins(Rule):
 
         names: List[str] = []
 
+        def leaf_name(i: int) -> str:
+            return f"r{i}[hbo]" if i in hbo_leaves else f"r{i}"
+
         def build(s: int) -> PlanNode:
             _c, _r, _t, b = best[s]
             if b[0] == "leaf":
                 i = b[1]
-                names.append(f"r{b[1]}")
+                names.append(leaf_name(i))
                 return leaves[i]
             _tag, ls, rs, crit = b
             left = build(ls)
@@ -544,18 +590,24 @@ class ReorderJoins(Rule):
             return self._cross(ctx, left, right)
 
         plan = build(full)
-        return plan, " ".join(names)
+        return plan, " ".join(names), bool(hbo_leaves)
 
-    def _order_greedy(self, ctx, calc, leaves, concrete, rel_syms, equi):
+    def _order_greedy(self, ctx, calc, leaves, concrete, rel_syms,
+                      equi, best, hbo_leaves):
         """Connected greedy ordering for wide regions (mirrors the
         pre-memo pass: largest relation first as the streaming probe,
-        then smallest estimated join output)."""
+        then smallest estimated join output).  ``best`` holds the
+        already-memoized per-leaf estimates."""
         n = len(leaves)
-        sizes = [calc.stats(c).row_count for c in concrete]
+        sizes = [best[1 << i][1] for i in range(n)]
+
+        def leaf_name(i: int) -> str:
+            return f"r{i}[hbo]" if i in hbo_leaves else f"r{i}"
+
         order = sorted(range(n), key=lambda i: -sizes[i])
         joined = {order[0]}
         plan, ctree = leaves[order[0]], concrete[order[0]]
-        names = [f"r{order[0]}"]
+        names = [leaf_name(order[0])]
         unjoined = order[1:]
         while unjoined:
             cand = None
@@ -580,9 +632,9 @@ class ReorderJoins(Rule):
                 plan = JoinNode("inner", plan, leaves[i], crit)
                 ctree = t
             joined.add(i)
-            names.append(f"⋈ r{i}")
+            names.append(f"⋈ {leaf_name(i)}")
             unjoined.remove(i)
-        return plan, " ".join(names)
+        return plan, " ".join(names), bool(hbo_leaves)
 
     def _cross(self, ctx: RuleContext, left: PlanNode,
                right: PlanNode) -> PlanNode:

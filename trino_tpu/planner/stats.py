@@ -92,11 +92,16 @@ class StatsCalculator:
         # stale when a freed node's address is reused (the optimizer
         # builds throwaway candidate JoinNodes in a loop)
         self._cache: Dict[int, tuple] = {}
+        #: estimate computations (memo misses) — the join-order DP is
+        #: O(3^n) estimator calls, so sharing one calculator per
+        #: optimize() run must provably reduce this count
+        self.calls = 0
 
     def stats(self, node: PlanNode) -> PlanStats:
         hit = self._cache.get(id(node))
         if hit is not None and hit[0] is node:
             return hit[1]
+        self.calls += 1
         m = getattr(self, "_s_" + type(node).__name__, None)
         got = m(node) if m is not None else self._default(node)
         if self.history is not None:

@@ -393,6 +393,126 @@ order by s_store_name, s_store_id, sun_sales, mon_sales, tue_sales,
          wed_sales, thu_sales, fri_sales, sat_sales
 limit 100
 """,
+    # q46: weekend coupon/profit per ticket where the buyer has since
+    # moved city (5-way fact join feeding a 2-way customer join)
+    46: """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       amt, profit
+from (select ss_ticket_number, ss_customer_sk, ca_city as bought_city,
+             sum(ss_coupon_amt) as amt, sum(ss_net_profit) as profit
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and ss_addr_sk = ca_address_sk
+        and (household_demographics.hd_dep_count = 4
+             or household_demographics.hd_vehicle_count = 3)
+        and d_dow in (5, 6)
+        and d_year in (1999, 2000, 2001)
+        and s_city in ('dolphins', 'silent')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number
+limit 100
+""",
+    # q68: month-start ticket totals for movers (q46's shape with
+    # extended price/tax/list aggregates)
+    68: """
+select c_last_name, c_first_name, ca_city, bought_city, ss_ticket_number,
+       extended_price, extended_tax, list_price
+from (select ss_ticket_number, ss_customer_sk, ca_city as bought_city,
+             sum(ss_ext_sales_price) as extended_price,
+             sum(ss_ext_list_price) as list_price,
+             sum(ss_ext_tax) as extended_tax
+      from store_sales, date_dim, store, household_demographics,
+           customer_address
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and ss_addr_sk = ca_address_sk
+        and d_dom between 1 and 2
+        and (household_demographics.hd_dep_count = 4
+             or household_demographics.hd_vehicle_count = 3)
+        and d_year in (1999, 2000, 2001)
+        and s_city in ('dolphins', 'silent')
+      group by ss_ticket_number, ss_customer_sk, ss_addr_sk, ca_city) dn,
+     customer, customer_address current_addr
+where ss_customer_sk = c_customer_sk
+  and customer.c_current_addr_sk = current_addr.ca_address_sk
+  and current_addr.ca_city <> bought_city
+order by c_last_name, ss_ticket_number
+limit 100
+""",
+    # q73: month-start tickets per customer in a buy-potential slice
+    # with a dependents-per-vehicle ratio filter
+    73: """
+select c_last_name, c_first_name, c_salutation, c_preferred_cust_flag,
+       ss_ticket_number, cnt
+from (select ss_ticket_number, ss_customer_sk, count(*) as cnt
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and d_dom between 1 and 2
+        and (household_demographics.hd_buy_potential = '>10000'
+             or household_demographics.hd_buy_potential = 'Unknown')
+        and household_demographics.hd_vehicle_count > 0
+        and case when household_demographics.hd_vehicle_count > 0
+                 then household_demographics.hd_dep_count /
+                      household_demographics.hd_vehicle_count
+                 else null end > 1
+        and d_year in (1999, 2000, 2001)
+        and s_county in ('around among', 'pending nag')
+      group by ss_ticket_number, ss_customer_sk) dj, customer
+where ss_customer_sk = c_customer_sk
+  and cnt between 1 and 5
+order by cnt desc, c_last_name asc, ss_ticket_number
+""",
+    # q79: one-weekday coupon/profit per ticket at mid-headcount stores
+    79: """
+select c_last_name, c_first_name,
+       substring(s_city from 1 for 30) as city, ss_ticket_number,
+       amt, profit
+from (select ss_ticket_number, ss_customer_sk, s_city,
+             sum(ss_coupon_amt) as amt, sum(ss_net_profit) as profit
+      from store_sales, date_dim, store, household_demographics
+      where ss_sold_date_sk = d_date_sk
+        and ss_store_sk = s_store_sk
+        and ss_hdemo_sk = hd_demo_sk
+        and (household_demographics.hd_dep_count = 6
+             or household_demographics.hd_vehicle_count > 2)
+        and d_dow = 1
+        and d_year in (1998, 1999, 2000)
+        and s_number_employees between 200 and 295
+      group by ss_ticket_number, ss_customer_sk, s_city) ms, customer
+where ss_customer_sk = c_customer_sk
+order by c_last_name, c_first_name,
+         substring(s_city from 1 for 30), profit, ss_ticket_number
+limit 100
+""",
+    # q84: returning customers in one city and income band (6-way
+    # dimension chain ending at the store_returns fact)
+    84: """
+select c_customer_id as customer_id,
+       c_last_name as customer_last_name,
+       c_first_name as customer_first_name
+from customer, customer_address, customer_demographics,
+     household_demographics, income_band, store_returns
+where ca_city = 'pending'
+  and c_current_addr_sk = ca_address_sk
+  and ib_lower_bound >= 30000
+  and ib_upper_bound <= 30000 + 50000
+  and ib_income_band_sk = hd_income_band_sk
+  and hd_demo_sk = c_current_hdemo_sk
+  and cd_demo_sk = c_current_cdemo_sk
+  and sr_cdemo_sk = cd_demo_sk
+order by c_customer_id, customer_last_name
+limit 100
+""",
     # q48: total store quantity under OR'd demographic/address slices
     48: """
 select sum(ss_quantity) q

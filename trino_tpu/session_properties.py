@@ -83,6 +83,8 @@ hbo_enabled                                runner.py,
                                            parallel/distributed.py,
                                            parallel/process_runner.py,
                                            parallel/worker.py
+hbo_reorder_joins_enabled                  planner/optimizer.py
+hbo_distribution_enabled                   parallel/distributed.py
 hbo_store_path                             runner.py,
                                            parallel/process_runner.py
 hbo_ewma_alpha                             runner.py,
@@ -520,6 +522,25 @@ register(SessionProperty(
     "decision node invalidates cached plans of the shape so the next "
     "run re-plans from history. Off = exactly the pre-HBO engine: no "
     "store writes, no per-page stats collection"))
+register(SessionProperty(
+    "hbo_reorder_joins_enabled", "boolean", True,
+    "Let recorded history price the cost-based join-order exploration "
+    "(ReorderJoins' DP over the flattened inner-join region): observed "
+    "per-relation cardinalities beat connector estimates, so a "
+    "connector lying by orders of magnitude reorders the join tree on "
+    "the statement's second run (EXPLAIN tags such relations [hbo] in "
+    "the order provenance). Off = the DP prices from connector "
+    "estimates only; no effect when hbo_enabled is off"))
+register(SessionProperty(
+    "hbo_distribution_enabled", "boolean", True,
+    "Let recorded history drive the broadcast-vs-partitioned exchange "
+    "choice: observed build rows beat broadcast_join_threshold "
+    "comparisons against connector estimates, and a build that "
+    "SPILLED on a prior run refuses broadcast outright (replicating a "
+    "build that overflowed one task's memory is strictly worse than "
+    "partitioning it). EXPLAIN renders distribution=... [source=hbo] "
+    "on affected joins. Off = connector estimates only; no effect "
+    "when hbo_enabled is off"))
 register(SessionProperty(
     "hbo_store_path", "varchar", "",
     "JSON sidecar path for the history store: loaded before the first "

@@ -262,10 +262,21 @@ class RuntimeStatsStore:
         self.invalidations = 0
         self.records = 0
         self.corrupt_loads = 0
+        #: plan decisions history CHANGED versus connector estimates
+        #: alone, by kind ("join_order" | "distribution") — bumped at
+        #: the decision sites (ReorderJoins, ExchangePlanner), the
+        #: trino_hbo_plan_flips family
+        self.plan_flips: Dict[str, int] = {}
         #: misestimate histogram (Q-error of estimate vs actual at
         #: record time): Prometheus-shaped cumulative buckets
         self._qerr = {"count": 0, "sum": 0.0,
                       "buckets": [[le, 0] for le in QERROR_BUCKETS]}
+
+    def note_plan_flip(self, kind: str):
+        """One plan decision just diverged from the connector-only
+        choice because recorded history priced it differently."""
+        with self._lock:
+            self.plan_flips[kind] = self.plan_flips.get(kind, 0) + 1
 
     # -- lookups -----------------------------------------------------------
 
@@ -373,7 +384,8 @@ class RuntimeStatsStore:
                     "hits": self.hits, "misses": self.misses,
                     "invalidations": self.invalidations,
                     "records": self.records,
-                    "corrupt_loads": self.corrupt_loads}
+                    "corrupt_loads": self.corrupt_loads,
+                    "plan_flips": sum(self.plan_flips.values())}
 
     def snapshot(self) -> List[dict]:
         """system.runtime.plan_stats rows: one per (statement, node)."""
@@ -395,7 +407,14 @@ class RuntimeStatsStore:
             qerr = {"count": self._qerr["count"],
                     "sum": self._qerr["sum"],
                     "buckets": [list(b) for b in self._qerr["buckets"]]}
+            flips = dict(self.plan_flips)
         return [
+            {"name": "trino_hbo_plan_flips", "type": "counter",
+             "help": "Plan decisions recorded history changed versus "
+                     "connector estimates alone "
+                     "(kind=join_order|distribution)",
+             "samples": [[{"kind": k}, flips.get(k, 0)]
+                         for k in ("join_order", "distribution")]},
             {"name": "trino_hbo_store_entries", "type": "gauge",
              "help": "History-based statistics store size "
                      "(kind=statements|nodes)",
@@ -529,6 +548,7 @@ class RuntimeStatsStore:
             self._stmts.clear()
             self.hits = self.misses = self.invalidations = 0
             self.records = self.corrupt_loads = 0
+            self.plan_flips = {}
             self._qerr = {"count": 0, "sum": 0.0,
                           "buckets": [[le, 0] for le in QERROR_BUCKETS]}
 
@@ -738,7 +758,8 @@ class HboContext:
         tree, estimated WITH history consulted — exactly what the next
         planning of this shape will see, so a converged history stops
         flagging material changes (the loop terminates)."""
-        from ..planner.plan import AggregationNode, JoinNode
+        from ..planner.plan import (AggregationNode, ExchangeNode,
+                                    JoinNode)
         from ..planner.stats import StatsCalculator
 
         calc = StatsCalculator(metadata, history=self)
@@ -752,6 +773,13 @@ class HboContext:
             if isinstance(node, JoinNode):
                 decisions.add(self.fp(node.left))
                 decisions.add(self.fp(node.right))
+                if getattr(node, "distribution", None) is not None \
+                        and isinstance(node.right, ExchangeNode):
+                    # DISTRIBUTION decision node: the broadcast-vs-
+                    # partitioned choice priced the PRE-exchange build
+                    # subtree, so a material misestimate THERE must
+                    # also invalidate cached plans of the shape
+                    decisions.add(self.fp(node.right.source))
             elif isinstance(node, AggregationNode) and node.group_keys:
                 decisions.add(self.fp(node))
 
